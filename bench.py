@@ -1,0 +1,166 @@
+"""North-star benchmark: 252-date x 500-asset index-replication backtest.
+
+TPU path: one jitted program — per-date Gram-matrix objective assembly,
+batched ADMM QP solve, tracking error — over all 252 rebalance dates at
+once (:mod:`porqua_tpu.tracking`). This is the workload BASELINE.json
+pins (reference ``example/index_replication.ipynb`` + ``backtest.ipynb``
+scales; the usa_returns blob is missing from the snapshot, so data is a
+synthetic factor model at the same shape).
+
+CPU baseline: the reference's solve path is a serial Python loop
+dispatching each date's QP to a CPU solver (``src/backtest.py:203`` ->
+``src/qp_problems.py:211``). qpsolvers/OSQP are not installed in this
+image, so the stand-in is the same OSQP-style ADMM algorithm in
+numpy/BLAS (single factorization + iteration loop per date), run
+serially over a sample of dates and scaled to the full backtest.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
+value = TPU wall-clock seconds for the full 252-date backtest and
+vs_baseline = CPU-baseline-seconds / TPU-seconds (speedup, higher is
+better).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+N_DATES = int(os.environ.get("PORQUA_BENCH_DATES", 252))
+N_ASSETS = int(os.environ.get("PORQUA_BENCH_ASSETS", 500))
+WINDOW = int(os.environ.get("PORQUA_BENCH_WINDOW", 252))
+BASELINE_SAMPLE = int(os.environ.get("PORQUA_BENCH_BASELINE_DATES", 8))
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+# ---------------------------------------------------------------------------
+# CPU baseline: OSQP-style ADMM in numpy (serial, one date at a time)
+# ---------------------------------------------------------------------------
+
+def admm_cpu(P, q, lb, ub, rho=0.1, sigma=1e-6, alpha=1.6,
+             eps=1e-5, max_iter=4000, check=25):
+    """Budget (sum w = 1) + box QP via the same splitting the device
+    solver uses; equality row handled with a 1000x rho weight."""
+    n = P.shape[0]
+    import scipy.linalg as sla
+
+    C = np.ones((1, n))
+    rho_eq = 1e3 * rho
+    x = np.zeros(n)
+    z = np.zeros(1)
+    w = np.clip(x, lb, ub)
+    y = np.zeros(1)
+    mu = np.zeros(n)
+
+    K = P + sigma * np.eye(n) + rho_eq * (C.T @ C) + rho * np.eye(n)
+    cho = sla.cho_factor(K)
+    for it in range(max_iter):
+        rhs = sigma * x - q + C.T @ (rho_eq * z - y) + (rho * w - mu)
+        xt = sla.cho_solve(cho, rhs)
+        zt = C @ xt
+        x = alpha * xt + (1 - alpha) * x
+        z_arg = alpha * zt + (1 - alpha) * z + y / rho_eq
+        z_new = np.clip(z_arg, 1.0, 1.0)
+        y = y + rho_eq * (alpha * zt + (1 - alpha) * z - z_new)
+        z = z_new
+        w_arg = alpha * xt + (1 - alpha) * w + mu / rho
+        w_new = np.clip(w_arg, lb, ub)
+        mu = mu + rho * (alpha * xt + (1 - alpha) * w - w_new)
+        w = w_new
+        if (it + 1) % check == 0:
+            r_prim = max(abs((C @ x - z).item()), float(np.max(np.abs(x - w))))
+            r_dual = float(np.max(np.abs(P @ x + q + C.T @ y + mu)))
+            if r_prim < eps and r_dual < eps:
+                break
+    return x, it + 1
+
+
+def run_baseline(Xs_np, ys_np, n_sample):
+    """Serial CPU solves over a sample of dates; returns (total_s, tes)."""
+    times, tes = [], []
+    for i in range(n_sample):
+        X, y = Xs_np[i], ys_np[i]
+        t0 = time.perf_counter()
+        P = 2.0 * (X.T @ X)
+        q = -2.0 * (X.T @ y)
+        x, iters = admm_cpu(P, q, 0.0, 1.0)
+        times.append(time.perf_counter() - t0)
+        tes.append(float(np.sqrt(np.mean((X @ x - y) ** 2))))
+    return float(np.sum(times)), tes
+
+
+def main():
+    platform = os.environ.get("PORQUA_BENCH_PLATFORM")
+    import jax
+
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    import jax.numpy as jnp
+
+    from porqua_tpu.qp.solve import SolverParams
+    from porqua_tpu.tracking import synthetic_universe, tracking_step_jit
+
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform} ({dev.device_kind})")
+
+    key = jax.random.PRNGKey(42)
+    Xs, ys = synthetic_universe(
+        key, n_dates=N_DATES, window=WINDOW, n_assets=N_ASSETS,
+        dtype=jnp.float32,
+    )
+    jax.block_until_ready((Xs, ys))
+
+    # f32 on device: run ADMM to a loose in-loop tolerance (the f32
+    # residual floor is ~1e-3) and let the LU polish + iterative
+    # refinement land on the exact active-set solution. Empirically this
+    # matches the f64 baseline's tracking error at ~25 iterations/date,
+    # while pushing f32 ADMM to 1e-4 stalls and polishes worse.
+    params = SolverParams(max_iter=2000, eps_abs=1e-3, eps_rel=1e-3)
+
+    # Warmup (compile) then timed runs.
+    t0 = time.perf_counter()
+    out = tracking_step_jit(Xs, ys, params)
+    jax.block_until_ready(out)
+    log(f"compile+first run: {time.perf_counter() - t0:.2f}s")
+
+    runs = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = tracking_step_jit(Xs, ys, params)
+        jax.block_until_ready(out)
+        runs.append(time.perf_counter() - t0)
+    tpu_s = min(runs)
+    solved = int(np.sum(np.asarray(out.status) == 1))
+    te_dev = float(np.median(np.asarray(out.tracking_error)))
+    log(f"device runs: {['%.3f' % r for r in runs]}s; "
+        f"solved {solved}/{N_DATES}; median TE {te_dev:.3e}; "
+        f"median iters {float(np.median(np.asarray(out.iters))):.0f}")
+
+    # CPU baseline on a sample of dates, scaled to the full backtest.
+    Xs_np = np.asarray(Xs, dtype=np.float64)
+    ys_np = np.asarray(ys, dtype=np.float64)
+    n_sample = min(BASELINE_SAMPLE, N_DATES)
+    base_sample_s, base_tes = run_baseline(Xs_np, ys_np, n_sample)
+    base_s = base_sample_s * (N_DATES / n_sample)
+    log(f"cpu baseline: {base_sample_s:.2f}s for {n_sample} dates "
+        f"-> {base_s:.2f}s extrapolated; median TE {np.median(base_tes):.3e}")
+
+    print(json.dumps({
+        "metric": f"index-replication backtest wall-clock "
+                  f"({N_DATES} dates x {N_ASSETS} assets, batched ADMM on-device "
+                  f"vs serial numpy-ADMM CPU)",
+        "value": round(tpu_s, 4),
+        "unit": "seconds",
+        "vs_baseline": round(base_s / tpu_s, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

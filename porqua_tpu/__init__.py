@@ -1,0 +1,79 @@
+"""PorQua-TPU: a TPU-native portfolio optimization and backtesting framework.
+
+A ground-up re-design of the capability surface of PorQua
+(github.com/amolrpatil21/PorQua — portfolio optimization and backtesting
+library) for TPU hardware via JAX/XLA:
+
+* The reference dispatches every rebalance date to an external C/C++ QP
+  solver through ``qpsolvers`` (reference ``src/qp_problems.py:211``).
+  Here the solver is a *batched* first-order ADMM solver written in JAX
+  (``porqua_tpu.qp``): a whole backtest of quadratic programs is solved
+  in one XLA program on the MXU.
+* The reference's rolling-rebalance loop is a serial Python ``for``
+  (reference ``src/backtest.py:203``). Here problem *building* stays
+  host-side (pandas-friendly), and the solve/accounting path is
+  ``vmap``/``lax.scan`` over rebalance dates on device.
+* Multi-chip scaling shards the (dates x benchmarks) batch over a
+  ``jax.sharding.Mesh`` (``porqua_tpu.parallel``).
+
+Public API mirrors the reference's capability surface: constraints DSL,
+optimization objectives, covariance/mean estimators, selection, item
+builders, backtest engine and portfolio accounting.
+"""
+
+__version__ = "0.1.0"
+
+from porqua_tpu.constraints import Constraints
+from porqua_tpu.qp.canonical import CanonicalQP
+from porqua_tpu.qp.solve import solve_qp, solve_qp_batch, QPSolution, SolverParams
+from porqua_tpu.estimators.covariance import Covariance, CovarianceSpecification
+from porqua_tpu.estimators.mean import MeanEstimator
+from porqua_tpu.optimization_data import OptimizationData
+from porqua_tpu.optimization import (
+    Optimization,
+    OptimizationParameter,
+    Objective,
+    EmptyOptimization,
+    MeanVariance,
+    QEQW,
+    LeastSquares,
+    WeightedLeastSquares,
+    LAD,
+    PercentilePortfolios,
+)
+from porqua_tpu.selection import Selection
+from porqua_tpu.builders import SelectionItemBuilder, OptimizationItemBuilder
+from porqua_tpu.portfolio import Portfolio, Strategy, floating_weights
+from porqua_tpu.backtest import Backtest, BacktestData, BacktestService
+
+__all__ = [
+    "Constraints",
+    "CanonicalQP",
+    "solve_qp",
+    "solve_qp_batch",
+    "QPSolution",
+    "SolverParams",
+    "Covariance",
+    "CovarianceSpecification",
+    "MeanEstimator",
+    "OptimizationData",
+    "Optimization",
+    "OptimizationParameter",
+    "Objective",
+    "EmptyOptimization",
+    "MeanVariance",
+    "QEQW",
+    "LeastSquares",
+    "WeightedLeastSquares",
+    "LAD",
+    "PercentilePortfolios",
+    "Selection",
+    "SelectionItemBuilder",
+    "OptimizationItemBuilder",
+    "Portfolio",
+    "Strategy",
+    "floating_weights",
+    "Backtest",
+    "BacktestData",
+    "BacktestService",
+]

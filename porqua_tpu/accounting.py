@@ -1,0 +1,170 @@
+"""Device-vectorized portfolio accounting: the whole P&L as one XLA program.
+
+The reference's return engine (``src/portfolio.py:205-245``,
+``Strategy.simulate``) loops over rebalance periods in Python, drifting
+weights with a pandas ``cumprod`` per period and concatenating the
+pieces. Here the entire simulation over (days x assets) is a handful of
+fused array ops:
+
+* one global ``cumprod`` of gross returns replaces all per-period
+  cumprods — the drifted weight at day t under the segment that started
+  at day s is ``w_s * G[t] / G[s]`` with ``G = cumprod(1 + R)``;
+* each day is assigned to its rebalance segment with a ``searchsorted``
+  (a day that *is* a rebalance date belongs to the *previous* segment,
+  matching the pandas engine where the new weights seed that day's level
+  and produce their first return the day after);
+* margin / cash / loan sleeves, turnover, variable and fixed costs are
+  computed per segment and broadcast.
+
+Everything is jittable and ``vmap``-able over a strategies axis, so a
+whole grid of backtests (dates x benchmarks) marks to market in one
+program. The pandas engine remains the golden reference in tests.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+
+class SimulationResult(NamedTuple):
+    returns: jax.Array      # (T,) daily strategy returns; 0 before the first rebdate
+    valid: jax.Array        # (T,) bool, True where a return is defined
+    turnover: jax.Array     # (D,) two-sided turnover at each rebalance
+    levels: jax.Array       # (T,) portfolio level under the active segment
+
+
+def simulate(weights: jax.Array,
+             returns: jax.Array,
+             reb_idx: jax.Array,
+             vc: float = 0.0,
+             fc: float = 0.0,
+             day_gaps: Optional[jax.Array] = None,
+             n_days_per_year: int = 252) -> SimulationResult:
+    """Simulate a rebalanced strategy (reference ``portfolio.py:205-245``).
+
+    Args:
+      weights: (D, N) portfolio weights decided at each rebalance date.
+      returns: (T, N) daily asset returns.
+      reb_idx: (D,) int positions of the rebalance dates within the T axis
+        (strictly increasing).
+      vc: variable (turnover-proportional) cost rate.
+      fc: fixed cost rate per year, compounded by calendar-day gaps.
+      day_gaps: (T,) calendar days since the previous row (0 for the
+        first); required when ``fc != 0``.
+    """
+    dtype = returns.dtype
+    T, _ = returns.shape
+    weights = jnp.asarray(weights, dtype)
+    reb_idx = jnp.asarray(reb_idx, jnp.int32)
+
+    # Global growth G[t] = prod_{s<=t} (1 + r_s); drifted weights under the
+    # segment seeded at s are w_s * G[t] / G[s] (the seed row replaces the
+    # rebalance day's own return, reference portfolio.py:278-281).
+    G = jnp.cumprod(1.0 + returns, axis=0)
+
+    days = jnp.arange(T)
+    # Day t belongs to segment seg[t]: the last rebdate strictly before t,
+    # so the return *on* a rebalance date still uses the old weights.
+    seg = jnp.searchsorted(reb_idx, days, side="left") - 1
+    seg_clip = jnp.clip(seg, 0, weights.shape[0] - 1)
+
+    w_seg = weights[seg_clip]                       # (T, N)
+    g_seed = G[reb_idx[seg_clip]]                   # (T, N) growth at seed day
+    w_float = w_seg * G / g_seed                    # (T, N) drifted weights
+    w_float_prev = w_seg * jnp.where(days[:, None] > 0, G[jnp.maximum(days - 1, 0)], 1.0) / g_seed
+
+    # Margin / cash / loan sleeves per rebalance (reference
+    # portfolio.py:220-227): constants within a segment.
+    short_sum = jnp.sum(jnp.minimum(weights, 0.0), axis=1)        # (D,)
+    long_sum = jnp.sum(jnp.maximum(weights, 0.0), axis=1)
+    margin = jnp.abs(short_sum)
+    cash = jnp.clip(1.0 - long_sum, 0.0, 1.0)
+    loan = 1.0 - (long_sum + cash) - (short_sum + margin)
+    sleeves = (margin + cash + loan)[seg_clip]                    # (T,)
+
+    level = sleeves + jnp.sum(w_float, axis=1)
+    level_prev = sleeves + jnp.sum(w_float_prev, axis=1)
+    ret = level / level_prev - 1.0
+
+    valid = (seg >= 0) & (days > reb_idx[0])
+    ret = jnp.where(valid, ret, 0.0)
+
+    # Turnover (rescale=False): drifted previous weights at the rebalance
+    # date vs the new weights (reference portfolio.py:109-121, 194-203).
+    prev_seg = jnp.maximum(jnp.arange(weights.shape[0]) - 1, 0)
+    g_at_reb = G[reb_idx]                                          # (D, N)
+    g_prev_seed = G[reb_idx[prev_seg]]
+    w_drift_prev = weights[prev_seg] * g_at_reb / g_prev_seed      # (D, N)
+    to = jnp.sum(jnp.abs(w_drift_prev - weights), axis=1)
+    to = to.at[0].set(jnp.sum(jnp.abs(weights[0])))
+
+    if vc != 0.0:
+        # Cost lands on the first defined return for the first rebalance
+        # and on the rebalance-date return otherwise (portfolio.py:234-239).
+        cost_t = jnp.zeros(T, dtype).at[reb_idx].add(to * vc)
+        first_ret_day = reb_idx[0] + 1
+        cost_t = cost_t.at[first_ret_day].add(cost_t[reb_idx[0]])
+        cost_t = cost_t.at[reb_idx[0]].set(0.0)
+        ret = ret - jnp.where(valid, cost_t, 0.0)
+
+    if fc != 0.0:
+        if day_gaps is None:
+            raise ValueError("day_gaps is required when fc != 0")
+        fixcost = (1.0 + fc) ** (jnp.asarray(day_gaps, dtype) / n_days_per_year) - 1.0
+        # The pandas engine charges no fixed cost on the very first return
+        # row (reference portfolio.py:240-243 slices [1:]).
+        charge = valid & (days > reb_idx[0] + 1)
+        ret = ret - jnp.where(charge, fixcost, 0.0)
+
+    return SimulationResult(returns=ret, valid=valid, turnover=to,
+                            levels=jnp.where(seg >= 0, level, 1.0))
+
+
+_simulate_jit = jax.jit(simulate, static_argnames=("vc", "fc", "n_days_per_year"))
+
+
+def simulate_strategy(strategy,
+                      return_series: pd.DataFrame,
+                      fc: float = 0.0,
+                      vc: float = 0.0,
+                      n_days_per_year: int = 252) -> pd.Series:
+    """Pandas-friendly wrapper: a ``Strategy`` in, a return Series out.
+
+    Drop-in accelerated replacement for ``Strategy.simulate`` (reference
+    ``portfolio.py:205-245``) for the rescale=False path; asset universe
+    may vary by date (weights are aligned to the full column set).
+    """
+    rebdates = strategy.get_rebalancing_dates()
+    W = (
+        strategy.get_weights_df()
+        .reindex(columns=return_series.columns)
+        .fillna(0.0)
+        .to_numpy(dtype=float)
+    )
+    dates = pd.to_datetime(pd.Index(rebdates))
+    reb_idx = return_series.index.get_indexer(dates, method="pad")
+    if (reb_idx < 0).any():
+        raise ValueError("all rebalance dates must fall inside the return series")
+
+    day_gaps = np.zeros(len(return_series.index))
+    day_gaps[1:] = (
+        (return_series.index[1:] - return_series.index[:-1])
+        .to_numpy().astype("timedelta64[D]").astype(float)
+    )
+
+    out = _simulate_jit(
+        jnp.asarray(W),
+        jnp.asarray(return_series.to_numpy(dtype=float)),
+        jnp.asarray(reb_idx),
+        vc=vc, fc=fc,
+        day_gaps=jnp.asarray(day_gaps),
+        n_days_per_year=n_days_per_year,
+    )
+    ret = np.asarray(out.returns)
+    valid = np.asarray(out.valid)
+    return pd.Series(ret[valid], index=return_series.index[valid])

@@ -1,0 +1,177 @@
+"""Backtest item builders — the per-rebalance-date plug-in API.
+
+Mirror of reference ``src/builders.py``: ``SelectionItemBuilder`` runs a
+``bibfn`` returning a named filter; ``OptimizationItemBuilder`` runs a
+``bibfn`` for side effects on the backtest service (optimization data,
+constraints). This is the reference's main extensibility point and is
+preserved as-is; the batched device backtest
+(:mod:`porqua_tpu.batch`) runs the same builders host-side for all
+dates in pass 1, then lowers the results to padded device arrays.
+
+Stale reference bibfns are fixed here (SURVEY.md section 2):
+``bibfn_selection_min_volume`` returns its filter instead of touching a
+nonexistent ``bs.rebalancing`` (reference ``builders.py:118``);
+``bibfn_selection_ltr`` is provided in :mod:`porqua_tpu.models.ltr`
+with the undefined-variable bugs fixed.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Any
+
+import numpy as np
+import pandas as pd
+
+
+class BacktestItemBuilder(ABC):
+    """Holds kwargs in ``.arguments``; callable per rebalance date
+    (reference ``builders.py:35-51``)."""
+
+    def __init__(self, **kwargs):
+        self._arguments = {}
+        self._arguments.update(kwargs)
+
+    @property
+    def arguments(self) -> dict:
+        return self._arguments
+
+    @arguments.setter
+    def arguments(self, value: dict) -> None:
+        self._arguments = value
+
+    @abstractmethod
+    def __call__(self, service, rebdate: str) -> None:
+        raise NotImplementedError("Method '__call__' must be implemented in derived class.")
+
+
+class SelectionItemBuilder(BacktestItemBuilder):
+
+    def __call__(self, bs, rebdate: str) -> None:
+        selection_item_builder_fn = self.arguments.get("bibfn")
+        if selection_item_builder_fn is None or not callable(selection_item_builder_fn):
+            raise ValueError("bibfn is not defined or not callable.")
+        item_value = selection_item_builder_fn(bs=bs, rebdate=rebdate, **self.arguments)
+        item_name = self.arguments.get("item_name")
+        bs.selection.add_filtered(filter_name=item_name, value=item_value)
+
+
+class OptimizationItemBuilder(BacktestItemBuilder):
+
+    def __call__(self, bs, rebdate: str) -> None:
+        optimization_item_builder_fn = self.arguments.get("bibfn")
+        if optimization_item_builder_fn is None or not callable(optimization_item_builder_fn):
+            raise ValueError("bibfn is not defined or not callable.")
+        optimization_item_builder_fn(bs=bs, rebdate=rebdate, **self.arguments)
+
+
+# --------------------------------------------------------------------------
+# Selection bibfns
+# --------------------------------------------------------------------------
+
+def bibfn_selection_data(bs, rebdate: str, **kwargs) -> pd.Series:
+    """All assets with return data (reference ``builders.py:124-135``)."""
+    data = bs.data.get("return_series")
+    if data is None:
+        raise ValueError("Return series data is missing.")
+    return pd.Series(np.ones(data.shape[1], dtype=int), index=data.columns, name="binary")
+
+
+def bibfn_selection_min_volume(bs, rebdate: str, **kwargs) -> pd.Series:
+    """Median-volume floor filter (reference ``builders.py:100-120``, with
+    the stale service mutation removed — it *returns* the filter)."""
+    width = kwargs.get("width", 365)
+    agg_fn = kwargs.get("agg_fn", np.median)
+    min_volume = kwargs.get("min_volume", 500_000)
+
+    vol = bs.data.get("volume_series")
+    if vol is None:
+        raise ValueError("Volume series data is missing.")
+    window = vol[vol.index <= rebdate].tail(width).fillna(0)
+    agg = window.apply(agg_fn, axis=0)
+    binary = (agg >= min_volume).astype(int)
+    binary.name = "binary"
+    return binary
+
+
+def bibfn_selection_ltr(bs, rebdate: str, **kwargs) -> pd.DataFrame:
+    """Learning-to-rank scoring filter; delegates to the models subpackage
+    (reference ``builders.py:138-180``, stale-code bugs fixed there)."""
+    from porqua_tpu.models.ltr import ltr_selection_scores
+
+    return ltr_selection_scores(bs=bs, rebdate=rebdate, **kwargs)
+
+
+# --------------------------------------------------------------------------
+# Optimization-data bibfns
+# --------------------------------------------------------------------------
+
+def bibfn_return_series(bs, rebdate: str, **kwargs) -> None:
+    """Trailing-window per-universe returns, weekends dropped
+    (reference ``builders.py:188-215``)."""
+    width = kwargs.get("width")
+    ids = bs.selection.selected
+    data = bs.data.get("return_series")
+    if data is None:
+        raise ValueError("Return series data is missing.")
+    return_series = data[data.index <= rebdate].tail(width)[ids]
+    return_series = return_series[return_series.index.dayofweek < 5]
+    bs.optimization_data["return_series"] = return_series
+
+
+def bibfn_bm_series(bs, rebdate: str, **kwargs) -> None:
+    """Benchmark window + optional date alignment
+    (reference ``builders.py:218-251``)."""
+    width = kwargs.get("width")
+    align = kwargs.get("align")
+    data = bs.data.get("bm_series")
+    if data is None:
+        raise ValueError("Benchmark return series data is missing.")
+    bm_series = data[data.index <= rebdate].tail(width)
+    bm_series = bm_series[bm_series.index.dayofweek < 5]
+    bs.optimization_data["bm_series"] = bm_series
+    if align:
+        bs.optimization_data.align_dates(
+            variable_names=["bm_series", "return_series"], dropna=True
+        )
+
+
+def bibfn_scores(bs, rebdate: str, **kwargs) -> None:
+    """Expose a trailing window of a scores frame to the optimizer."""
+    data = bs.data.get("scores")
+    if data is None:
+        raise ValueError("Scores data is missing.")
+    ids = bs.selection.selected
+    scores = data[data.index <= rebdate]
+    bs.optimization_data["scores"] = scores.iloc[[-1]][ids].T.squeeze(axis=1).to_frame("score") \
+        if isinstance(scores, pd.DataFrame) else scores
+
+
+# --------------------------------------------------------------------------
+# Constraint bibfns
+# --------------------------------------------------------------------------
+
+def bibfn_budget_constraint(bs, rebdate: str, **kwargs) -> None:
+    budget = kwargs.get("budget", 1)
+    bs.optimization.constraints.add_budget(rhs=budget, sense="=")
+
+
+def bibfn_box_constraints(bs, rebdate: str, **kwargs) -> None:
+    lower = kwargs.get("lower", 0)
+    upper = kwargs.get("upper", 1)
+    box_type = kwargs.get("box_type", "LongOnly")
+    bs.optimization.constraints.add_box(box_type=box_type, lower=lower, upper=upper)
+
+
+def bibfn_turnover_constraint(bs, rebdate: str, **kwargs) -> None:
+    """Turnover budget vs the previous (drifted) portfolio. The previous
+    weights are read from ``bs.settings['prev_weights']``, maintained by
+    the backtest loop."""
+    budget = kwargs.get("turnover_budget", 1.0)
+    x0 = bs.settings.get("prev_weights") or {}
+    bs.optimization.constraints.add_l1("turnover", rhs=budget, x0=dict(x0))
+
+
+def bibfn_leverage_constraint(bs, rebdate: str, **kwargs) -> None:
+    budget = kwargs.get("leverage_budget", 2.0)
+    bs.optimization.constraints.add_l1("leverage", rhs=budget)

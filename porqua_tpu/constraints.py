@@ -1,0 +1,277 @@
+"""Declarative portfolio-constraint container and canonicalization.
+
+Host-side mirror of the reference's constraints DSL
+(``/root/reference/src/constraints.py``): budget (eq/ineq), box
+(LongOnly / LongShort / Unbounded), arbitrary linear rows with
+``=``/``<=``/``>=`` senses, and symbolic L1 constraints (turnover,
+leverage). Two lowerings are provided:
+
+* :meth:`Constraints.to_GhAb` — the reference's standard-form output
+  ``G x <= h``, ``A x = b`` (``constraints.py:114-167``), kept for API
+  parity and the shape-contract unit tests.
+* :meth:`Constraints.to_canonical` — the TPU-native lowering to a
+  *static-shape* :class:`~porqua_tpu.qp.canonical.CanonicalQP`: rows are
+  padded to a fixed count with +/-inf bounds so a whole backtest of
+  per-date problems stacks into one batched device array.
+
+Everything here is pandas/numpy; nothing is traced. This is the host
+side of the host-build / device-solve split.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Dict, Optional
+
+import numpy as np
+import pandas as pd
+
+
+def match_arg(x, lst):
+    """First element of ``lst`` containing ``x`` (R-style partial matching,
+    reference ``constraints.py:175``)."""
+    matches = [el for el in lst if x in el]
+    if not matches:
+        raise ValueError(f"{x!r} does not match any of {lst}")
+    return matches[0]
+
+
+def box_constraint(box_type: str = "LongOnly", lower=None, upper=None) -> dict:
+    """Resolve box-type defaults (reference ``constraints.py:178-204``)."""
+    box_type = match_arg(box_type, ["LongOnly", "LongShort", "Unbounded"])
+
+    if box_type == "Unbounded":
+        lower = float("-inf") if lower is None else lower
+        upper = float("inf") if upper is None else upper
+    elif box_type == "LongShort":
+        lower = -1 if lower is None else lower
+        upper = 1 if upper is None else upper
+    else:  # LongOnly
+        if lower is None:
+            if upper is None:
+                lower, upper = 0, 1
+            else:
+                lower = upper * 0
+        else:
+            if not np.isscalar(lower) and any(l < 0 for l in lower):
+                raise ValueError(
+                    "Inconsistent lower bounds for box_type 'LongOnly'. "
+                    "Change box_type to LongShort or ensure that lower >= 0."
+                )
+            upper = lower * 0 + 1 if upper is None else upper
+
+    return {"box_type": box_type, "lower": lower, "upper": upper}
+
+
+def linear_constraint(Amat=None, sense: str = "=", rhs=float("inf"),
+                      index_or_name=None, a_values=None) -> dict:
+    """Plain-dict linear-constraint record (reference ``constraints.py:206-218``)."""
+    ans = {"Amat": Amat, "sense": sense, "rhs": rhs}
+    if index_or_name is not None:
+        ans["index_or_name"] = index_or_name
+    if a_values is not None:
+        ans["a_values"] = a_values
+    return ans
+
+
+class Constraints:
+    """Constraint container for one asset universe (``selection``).
+
+    API-compatible with the reference class (``constraints.py:23-167``):
+    ``add_budget``, ``add_box``, ``add_linear``, ``add_l1``, ``to_GhAb``.
+    """
+
+    def __init__(self, selection="NA") -> None:
+        if not all(isinstance(item, str) for item in selection):
+            raise ValueError("argument 'selection' has to be a character vector.")
+        self.selection = selection
+        self.budget = {"Amat": None, "sense": None, "rhs": None}
+        self.box = {"box_type": "NA", "lower": None, "upper": None}
+        self.linear = {"Amat": None, "sense": None, "rhs": None}
+        self.l1 = {}
+
+    def __str__(self) -> str:
+        return " ".join(f"\n{key}:\n\n{vars(self)[key]}\n" for key in vars(self))
+
+    # ------------------------------------------------------------------
+    # Builders
+    # ------------------------------------------------------------------
+
+    def add_budget(self, rhs=1, sense: str = "=") -> None:
+        if self.budget.get("rhs") is not None:
+            warnings.warn("Existing budget constraint is overwritten\n")
+        a_values = pd.Series(np.ones(len(self.selection)), index=self.selection)
+        self.budget = {"Amat": a_values, "sense": sense, "rhs": rhs}
+
+    def add_box(self, box_type: str = "LongOnly", lower=None, upper=None) -> None:
+        boxcon = box_constraint(box_type, lower, upper)
+        if np.isscalar(boxcon["lower"]):
+            boxcon["lower"] = pd.Series(
+                np.full(len(self.selection), float(boxcon["lower"])), index=self.selection
+            )
+        if np.isscalar(boxcon["upper"]):
+            boxcon["upper"] = pd.Series(
+                np.full(len(self.selection), float(boxcon["upper"])), index=self.selection
+            )
+        if (boxcon["upper"] < boxcon["lower"]).any():
+            raise ValueError("Some lower bounds are higher than the corresponding upper bounds.")
+        self.box = boxcon
+
+    def add_linear(self,
+                   Amat: Optional[pd.DataFrame] = None,
+                   a_values: Optional[pd.Series] = None,
+                   sense="=",
+                   rhs=None,
+                   name: Optional[str] = None) -> None:
+        if Amat is None:
+            if a_values is None:
+                raise ValueError("Either 'Amat' or 'a_values' must be provided.")
+            Amat = pd.DataFrame(a_values).T.reindex(columns=self.selection).fillna(0)
+            if name is not None:
+                Amat.index = [name]
+
+        if isinstance(sense, str):
+            sense = pd.Series([sense])
+        if isinstance(rhs, (int, float)):
+            rhs = pd.Series([rhs])
+
+        if self.linear["Amat"] is not None:
+            Amat = pd.concat([self.linear["Amat"], Amat], axis=0, ignore_index=False)
+            sense = pd.concat([self.linear["sense"], sense], axis=0, ignore_index=False)
+            rhs = pd.concat([self.linear["rhs"], rhs], axis=0, ignore_index=False)
+
+        Amat = Amat.fillna(0)
+        self.linear = {"Amat": Amat, "sense": sense, "rhs": rhs}
+
+    def add_l1(self, name: str, rhs=None, x0=None, *args, **kwargs) -> None:
+        """Record an L1 constraint symbolically (turnover / leverage).
+
+        Mirror of reference ``constraints.py:97-112``. The TPU solve path
+        consumes these either through static-shape linearization
+        (:mod:`porqua_tpu.qp.lift`) or as prox terms in the ADMM solver.
+        """
+        if rhs is None:
+            raise TypeError("argument 'rhs' is required.")
+        con = {"rhs": rhs}
+        if x0:
+            con["x0"] = x0
+        for i, arg in enumerate(args):
+            con[f"arg{i}"] = arg
+        con.update(kwargs)
+        self.l1[name] = con
+
+    # ------------------------------------------------------------------
+    # Lowerings
+    # ------------------------------------------------------------------
+
+    def to_GhAb(self, lbub_to_G: bool = False) -> Dict[str, Optional[np.ndarray]]:
+        """Standard form ``{'G','h','A','b'}`` with all inequalities as ``<=``.
+
+        Reference-parity output (``constraints.py:114-167``) including the
+        row ordering: budget first, then (optionally) box-as-G rows, then
+        user linear rows split into equalities and inequalities with
+        ``>=`` rows sign-flipped.
+        """
+        A = b = G = h = None
+
+        if self.budget["Amat"] is not None:
+            if self.budget["sense"] == "=":
+                A = np.asarray(self.budget["Amat"], dtype=float)
+                b = np.array(self.budget["rhs"], dtype=float)
+            else:
+                G = np.asarray(self.budget["Amat"], dtype=float)
+                h = np.array(self.budget["rhs"], dtype=float)
+
+        if lbub_to_G:
+            eye = np.eye(len(self.selection))
+            G_tmp = np.concatenate((-eye, eye), axis=0)
+            h_tmp = np.concatenate(
+                (-np.asarray(self.box["lower"], dtype=float),
+                 np.asarray(self.box["upper"], dtype=float))
+            )
+            G = np.vstack((G, G_tmp)) if G is not None else G_tmp
+            h = np.concatenate((h, h_tmp), axis=None) if h is not None else h_tmp
+
+        if self.linear["Amat"] is not None:
+            Amat = self.linear["Amat"].copy()
+            rhs = self.linear["rhs"].copy()
+
+            idx_geq = np.asarray(self.linear["sense"] == ">=")
+            if idx_geq.sum() > 0:
+                Amat[idx_geq] = -Amat[idx_geq]
+                rhs[idx_geq] = -rhs[idx_geq]
+
+            G_tmp = h_tmp = None
+            idx_eq = np.asarray(self.linear["sense"] == "=")
+            if idx_eq.sum() > 0:
+                A_tmp = Amat[idx_eq].to_numpy()
+                b_tmp = rhs[idx_eq].to_numpy()
+                A = np.vstack((A, A_tmp)) if A is not None else A_tmp
+                b = np.concatenate((b, b_tmp), axis=None) if b is not None else b_tmp
+                if idx_eq.sum() < Amat.shape[0]:
+                    G_tmp = Amat[~idx_eq].to_numpy()
+                    h_tmp = rhs[~idx_eq].to_numpy()
+            else:
+                G_tmp = Amat.to_numpy()
+                h_tmp = rhs.to_numpy()
+
+            if G_tmp is not None:
+                G = np.vstack((G, G_tmp)) if G is not None else G_tmp
+                h = np.concatenate((h, h_tmp), axis=None) if h is not None else h_tmp
+
+        A = A.reshape(-1, A.shape[-1]) if A is not None else None
+        G = G.reshape(-1, G.shape[-1]) if G is not None else None
+        return {"G": G, "h": h, "A": A, "b": b}
+
+    def to_canonical(self,
+                     P: Optional[np.ndarray] = None,
+                     q: Optional[np.ndarray] = None,
+                     constant: float = 0.0,
+                     n_max: Optional[int] = None,
+                     m_max: Optional[int] = None):
+        """Lower constraints (+ optional objective) to a padded CanonicalQP.
+
+        All row types collapse into interval form ``l <= Cx <= u`` (eq
+        rows have ``l == u``); the box becomes per-variable ``lb/ub``.
+        Rows are padded to ``m_max`` and variables to ``n_max`` so that
+        per-date problems of differing active-universe size batch into
+        one array. See :class:`porqua_tpu.qp.canonical.CanonicalQP`.
+        """
+        from porqua_tpu.qp.canonical import CanonicalQP
+
+        n = len(self.selection)
+        GhAb = self.to_GhAb()
+
+        rows, lo, hi = [], [], []
+        if GhAb["A"] is not None:
+            rows.append(GhAb["A"])
+            lo.append(np.atleast_1d(GhAb["b"]))
+            hi.append(np.atleast_1d(GhAb["b"]))
+        if GhAb["G"] is not None:
+            rows.append(GhAb["G"])
+            lo.append(np.full(GhAb["G"].shape[0], -np.inf))
+            hi.append(np.atleast_1d(GhAb["h"]))
+
+        C = np.concatenate(rows, axis=0) if rows else np.zeros((0, n))
+        l = np.concatenate(lo) if lo else np.zeros((0,))
+        u = np.concatenate(hi) if hi else np.zeros((0,))
+
+        if self.box["box_type"] != "NA":
+            lb = np.asarray(self.box["lower"], dtype=float)
+            ub = np.asarray(self.box["upper"], dtype=float)
+        else:
+            lb = np.full(n, -np.inf)
+            ub = np.full(n, np.inf)
+
+        if P is None:
+            P = np.zeros((n, n))
+        if q is None:
+            q = np.zeros(n)
+
+        return CanonicalQP.build(
+            P=np.asarray(P, dtype=float),
+            q=np.asarray(q, dtype=float),
+            C=C, l=l, u=u, lb=lb, ub=ub,
+            constant=float(constant),
+            n_max=n_max, m_max=m_max,
+        )

@@ -1,0 +1,73 @@
+"""Expected-return estimation (mirror of reference ``src/mean_estimation.py``).
+
+Geometric mean of returns with momentum/reversal windowing: keep the
+last ``n_mom`` observations, drop the most recent ``n_rev`` (reference
+``mean_estimation.py:39-48``). The array path is a pure function with
+static window sizes so it vmaps over a batch of date windows.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def geometric_mean(X: jax.Array,
+                   n_mom: Optional[int] = None,
+                   n_rev: int = 0,
+                   scalefactor: float = 1.0) -> jax.Array:
+    """mu = exp(mean(log(1 + X_window)) * scalefactor) - 1 over axis -2."""
+    T = X.shape[-2]
+    n_mom = T if n_mom is None else int(n_mom)
+    start = max(T - n_mom, 0)
+    stop = start + max(n_mom - n_rev, 0)
+    window = X[..., start:stop, :]
+    return jnp.exp(jnp.log1p(window).mean(axis=-2) * scalefactor) - 1.0
+
+
+class MeanEstimator:
+    """Spec-dict dispatch estimator (reference ``mean_estimation.py:23-37``)."""
+
+    def __init__(self, **kwargs) -> None:
+        self.spec = {
+            "method": "geometric",
+            "scalefactor": 1,
+            "n_mom": None,
+            "n_rev": None,
+        }
+        self.spec.update(kwargs)
+
+    def estimate_array(self, X: jax.Array) -> jax.Array:
+        fun = getattr(self, f'estimate_{self.spec["method"]}', None)
+        if fun is None:
+            raise NotImplementedError(
+                f'mean estimation method {self.spec["method"]!r} is not implemented'
+            )
+        return fun(X)
+
+    def estimate(self, X):
+        import pandas as pd
+
+        if isinstance(X, pd.DataFrame):
+            out = self.estimate_array(jnp.asarray(X.to_numpy(dtype=np.float64)))
+            return pd.Series(np.asarray(out), index=X.columns)
+        return self.estimate_array(jnp.asarray(X))
+
+    def estimate_geometric(self, X: jax.Array) -> jax.Array:
+        n_mom = self.spec.get("n_mom")
+        n_rev = self.spec.get("n_rev") or 0
+        scalefactor = self.spec.get("scalefactor") or 1
+        return geometric_mean(X, n_mom=n_mom, n_rev=n_rev, scalefactor=scalefactor)
+
+    def estimate_arithmetic(self, X: jax.Array) -> jax.Array:
+        """Simple mean over the same momentum/reversal window."""
+        T = X.shape[-2]
+        n_mom = self.spec.get("n_mom") or T
+        n_rev = self.spec.get("n_rev") or 0
+        scalefactor = self.spec.get("scalefactor") or 1
+        start = max(T - n_mom, 0)
+        stop = start + max(n_mom - n_rev, 0)
+        return X[..., start:stop, :].mean(axis=-2) * scalefactor

@@ -1,0 +1,89 @@
+"""Learning-to-rank asset selection scoring.
+
+Working replacement for the reference's stale XGBoost LTR bibfn
+(reference ``src/builders.py:138-180``, which references an undefined
+``selected`` variable and a missing ``import xgb`` — SURVEY.md section
+2). Scores assets at a rebalance date by a pairwise-ranking gradient
+boosted model trained on trailing feature/return cross-sections.
+
+xgboost is not available in this image; the model backend is
+sklearn's HistGradientBoostingRegressor fit on rank-transformed labels
+(a pointwise LTR surrogate), which keeps the bibfn contract identical:
+it returns a DataFrame with ``scores`` and a ``binary`` column marking
+the top-k ranked assets. Training runs host-side, off the hot path —
+the same placement the reference uses.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import pandas as pd
+
+
+def _rank_labels(returns: pd.Series, n_bins: int = 10) -> pd.Series:
+    """Cross-sectional decile rank labels (0 = worst, n_bins-1 = best)."""
+    pct = returns.rank(pct=True, method="first")
+    return np.minimum((pct * n_bins).astype(int), n_bins - 1)
+
+
+def ltr_selection_scores(bs,
+                         rebdate: str,
+                         feature_key: str = "features",
+                         return_key: str = "return_series",
+                         train_dates: int = 12,
+                         horizon: int = 21,
+                         top_k: Optional[int] = None,
+                         **kwargs) -> pd.DataFrame:
+    """Score the current universe with a ranking model.
+
+    ``bs.data[feature_key]``: DataFrame indexed by (date, asset) or a
+    dict date -> DataFrame(asset x features). Labels are forward
+    ``horizon``-day returns ranked cross-sectionally, from the
+    ``train_dates`` most recent feature cross-sections before
+    ``rebdate``.
+    """
+    from sklearn.ensemble import HistGradientBoostingRegressor
+
+    features = bs.data.get(feature_key)
+    returns = bs.data.get(return_key)
+    if features is None or returns is None:
+        raise ValueError(f"'{feature_key}' and '{return_key}' data are required for LTR selection.")
+
+    if isinstance(features, pd.DataFrame) and isinstance(features.index, pd.MultiIndex):
+        by_date = {d: features.xs(d, level=0) for d in features.index.get_level_values(0).unique()}
+    else:
+        by_date = dict(features)
+
+    reb_ts = pd.to_datetime(rebdate)
+    past_dates = sorted(d for d in by_date if pd.to_datetime(d) < reb_ts)[-train_dates:]
+    if not past_dates:
+        raise ValueError(f"no feature cross-sections before {rebdate}")
+
+    X_rows, y_rows = [], []
+    for d in past_dates:
+        xsec = by_date[d].dropna()
+        d_ts = pd.to_datetime(d)
+        future = returns[returns.index > d_ts].head(horizon)
+        if future.empty:
+            continue
+        fwd = (1.0 + future).prod() - 1.0
+        common = xsec.index.intersection(fwd.index)
+        if len(common) < 2:
+            continue
+        X_rows.append(xsec.loc[common])
+        y_rows.append(_rank_labels(fwd[common]))
+    if not X_rows:
+        raise ValueError("no usable (features, forward return) training pairs")
+
+    model = HistGradientBoostingRegressor(max_iter=100, max_depth=3, random_state=0)
+    model.fit(pd.concat(X_rows).to_numpy(), pd.concat(y_rows).to_numpy())
+
+    current_dates = sorted(d for d in by_date if pd.to_datetime(d) <= reb_ts)
+    xsec_now = by_date[current_dates[-1]].dropna()
+    scores = pd.Series(model.predict(xsec_now.to_numpy()), index=xsec_now.index)
+
+    k = top_k if top_k is not None else max(1, len(scores) // 2)
+    top = scores.rank(ascending=False, method="first") <= k
+    return pd.DataFrame({"values": scores, "binary": top.astype(int)})

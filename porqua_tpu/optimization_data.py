@@ -1,0 +1,60 @@
+"""Named time-series container for one optimization problem.
+
+Host-side mirror of reference ``src/optimization_data.py``: a dict of
+aligned pandas series/frames (return_series, bm_series, scores, ...)
+with optional per-key lags and date alignment by index intersection.
+Also adds the ``train_test_split`` used by the reference's ml notebook
+(called at ``example/ml.ipynb`` cell 4 but missing from the reference
+snapshot — stale API we restore here).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import pandas as pd
+
+
+class OptimizationData(dict):
+
+    def __init__(self, align=True, lags={}, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.__dict__ = self
+        if len(lags) > 0:
+            for key in lags.keys():
+                self[key] = self[key].shift(lags[key])
+        if align and len(self) > 0:
+            self.align_dates()
+
+    def align_dates(self, variable_names: Optional[list] = None, dropna: bool = True) -> None:
+        if variable_names is None:
+            variable_names = list(self.keys())
+        index = self.intersecting_dates(variable_names=list(variable_names), dropna=dropna)
+        for key in variable_names:
+            self[key] = self[key].loc[index]
+
+    def intersecting_dates(self,
+                           variable_names: Optional[list] = None,
+                           dropna: bool = True) -> pd.Index:
+        if variable_names is None:
+            variable_names = list(self.keys())
+        if dropna:
+            for variable_name in variable_names:
+                self[variable_name] = self[variable_name].dropna()
+        index = self.get(variable_names[0]).index
+        for variable_name in variable_names:
+            index = index.intersection(self.get(variable_name).index)
+        return index
+
+    def train_test_split(self, test_size: float = 0.2, keys: Optional[list] = None):
+        """Chronological train/test split of every (or selected) series."""
+        if keys is None:
+            keys = list(self.keys())
+        first = self[keys[0]]
+        cut = int(round(len(first.index) * (1.0 - test_size)))
+        train = {k: self[k].iloc[:cut] for k in keys}
+        test = {k: self[k].iloc[cut:] for k in keys}
+        return (
+            OptimizationData(align=False, **train),
+            OptimizationData(align=False, **test),
+        )

@@ -1,0 +1,15 @@
+from porqua_tpu.parallel.mesh import (
+    batch_sharding,
+    make_mesh,
+    pad_batch_to_mesh,
+    shard_qp_batch,
+    solve_qp_sharded,
+)
+
+__all__ = [
+    "batch_sharding",
+    "make_mesh",
+    "pad_batch_to_mesh",
+    "shard_qp_batch",
+    "solve_qp_sharded",
+]

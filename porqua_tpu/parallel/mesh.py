@@ -1,0 +1,101 @@
+"""Multi-chip scaling: shard the problem batch over a device mesh.
+
+The reference has no distributed execution of any kind (SURVEY.md
+section 2, "parallelism strategies: none") — its only scaling axis is a
+serial Python loop. The TPU-native design promotes the semantic batch
+axes (rebalance dates x benchmarks/strategies) to a 2-D
+``jax.sharding.Mesh`` and lets XLA's SPMD partitioner place one shard of
+the stacked :class:`~porqua_tpu.qp.canonical.CanonicalQP` batch on each
+chip. Every QP in the batch is independent, so the program runs with
+**zero cross-chip collectives in the hot loop**; the only communication
+is the implicit final all-gather of per-problem results over ICI. DCN
+enters only for multi-host input pipelines (host-side pass 1), which is
+plain data loading — no custom communication backend is required, and
+none is built.
+
+``shard_qp_batch`` works for any pytree-of-arrays batch: it maps the
+leading (or leading-two) axes onto the mesh and replicates everything
+else. Because each field of the batch has the batch dimension leading,
+a single ``NamedSharding`` spec per rank suffices.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from porqua_tpu.qp.canonical import CanonicalQP
+from porqua_tpu.qp.solve import QPSolution, SolverParams, solve_qp_batch
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              axis_names: Tuple[str, ...] = ("dates",),
+              shape: Optional[Sequence[int]] = None) -> Mesh:
+    """Build a 1-D (dates) or 2-D (benchmarks x dates) device mesh.
+
+    On real hardware the axes ride ICI; under
+    ``--xla_force_host_platform_device_count`` the same program compiles
+    and runs on virtual CPU devices (the test/dry-run path).
+    """
+    devices = jax.devices()
+    n = len(devices) if n_devices is None else n_devices
+    devices = np.asarray(devices[:n])
+    if shape is None:
+        shape = (n,) if len(axis_names) == 1 else None
+    if shape is None:
+        raise ValueError("explicit `shape` required for a multi-axis mesh")
+    return Mesh(devices.reshape(tuple(shape)), axis_names)
+
+
+def batch_sharding(mesh: Mesh, rank: int, n_batch_axes: int = 1) -> NamedSharding:
+    """Sharding for one field: batch axes on the mesh, the rest replicated."""
+    spec = tuple(mesh.axis_names[:n_batch_axes]) + (None,) * (rank - n_batch_axes)
+    return NamedSharding(mesh, P(*spec))
+
+
+def shard_qp_batch(qp: CanonicalQP, mesh: Mesh, n_batch_axes: int = 1) -> CanonicalQP:
+    """Place a stacked problem batch on the mesh, split along the batch axes.
+
+    Pads the batch up to a multiple of the mesh size with copies of the
+    first problem (masked out by callers via the returned ``n_real`` if
+    needed — padding problems solve identically and are simply dropped).
+    """
+    return jax.tree.map(
+        lambda arr: jax.device_put(arr, batch_sharding(mesh, arr.ndim, n_batch_axes)),
+        qp,
+    )
+
+
+def pad_batch_to_mesh(qp: CanonicalQP, mesh_size: int) -> Tuple[CanonicalQP, int]:
+    """Pad the leading axis to a multiple of the mesh size (XLA requires an
+    even split); returns (padded batch, real count)."""
+    n_real = qp.P.shape[0]
+    rem = (-n_real) % mesh_size
+    if rem == 0:
+        return qp, n_real
+    reps = -(-rem // n_real)  # rem may exceed n_real on large meshes
+    pad = jax.tree.map(
+        lambda a: jnp.concatenate([a] + [a] * reps, axis=0)[: n_real + rem], qp
+    )
+    return pad, n_real
+
+
+def solve_qp_sharded(qp: CanonicalQP,
+                     mesh: Mesh,
+                     params: SolverParams = SolverParams()) -> QPSolution:
+    """Solve a stacked batch with its leading axis sharded over the mesh.
+
+    The jitted program is the same batched ADMM as single-chip
+    (:func:`porqua_tpu.qp.solve.solve_qp_batch`); XLA's partitioner sees
+    the input sharding and runs one batch shard per chip, no collectives
+    until results are gathered.
+    """
+    mesh_size = int(np.prod(mesh.devices.shape))
+    qp, n_real = pad_batch_to_mesh(qp, mesh_size)
+    qp = shard_qp_batch(qp, mesh)
+    sol = solve_qp_batch(qp, params)
+    return jax.tree.map(lambda a: a[:n_real], sol)

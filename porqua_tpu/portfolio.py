@@ -1,0 +1,301 @@
+"""Portfolio & strategy accounting (mirror of reference ``src/portfolio.py``).
+
+Host-side API parity: ``Portfolio`` (rebalancing date + weights dict),
+``Strategy`` (list of portfolios, turnover, simulate), and the
+``floating_weights`` drift helper. The device-side vectorized return
+engine — the whole simulation as one XLA program over (dates x assets)
+— lives in :mod:`porqua_tpu.accounting`; ``Strategy.simulate`` here
+keeps the reference's pandas semantics and is the golden reference the
+vectorized engine is tested against.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+
+class Portfolio:
+
+    def __init__(self,
+                 rebalancing_date: str = None,
+                 weights: dict = {},
+                 name: str = None,
+                 init_weights: dict = {}):
+        self.rebalancing_date = rebalancing_date
+        self.weights = weights
+        self.name = name
+        self.init_weights = init_weights
+
+    @staticmethod
+    def empty() -> "Portfolio":
+        return Portfolio()
+
+    @property
+    def weights(self):
+        return self._weights
+
+    @weights.setter
+    def weights(self, new_weights: dict):
+        if not isinstance(new_weights, dict):
+            if hasattr(new_weights, "to_dict"):
+                new_weights = new_weights.to_dict()
+            else:
+                raise TypeError("weights must be a dictionary")
+        self._weights = new_weights
+
+    def get_weights_series(self) -> pd.Series:
+        return pd.Series(self._weights)
+
+    @property
+    def rebalancing_date(self):
+        return self._rebalancing_date
+
+    @rebalancing_date.setter
+    def rebalancing_date(self, new_date: str):
+        if new_date and not isinstance(new_date, str):
+            raise TypeError("date must be a string")
+        self._rebalancing_date = new_date
+
+    @property
+    def name(self):
+        return self._name
+
+    @name.setter
+    def name(self, new_name: str):
+        if new_name is not None and not isinstance(new_name, str):
+            raise TypeError("name must be a string")
+        self._name = new_name
+
+    def __repr__(self):
+        return f"Portfolio(rebalancing_date={self.rebalancing_date}, weights={self.weights})"
+
+    def float_weights(self, return_series: pd.DataFrame, end_date: str, rescale: bool = False):
+        if self.weights is not None:
+            return floating_weights(
+                X=return_series,
+                w=self.weights,
+                start_date=self.rebalancing_date,
+                end_date=end_date,
+                rescale=rescale,
+            )
+        return None
+
+    def initial_weights(self,
+                        selection,
+                        return_series: pd.DataFrame,
+                        end_date: str,
+                        rescale: bool = True):
+        if not hasattr(self, "_initial_weights"):
+            if self.rebalancing_date is not None and self.weights is not None:
+                w_init = dict.fromkeys(selection, 0)
+                w_float = self.float_weights(
+                    return_series=return_series, end_date=end_date, rescale=rescale
+                )
+                w_floated = w_float.iloc[-1]
+                w_init.update({key: w_floated[key] for key in w_init.keys() & w_floated.keys()})
+                self._initial_weights = w_init
+            else:
+                self._initial_weights = None
+        return self._initial_weights
+
+    def turnover(self, portfolio: "Portfolio", return_series: pd.DataFrame, rescale=True):
+        """Two-sided turnover: drifted old weights vs the newly decided ones.
+
+        The reference's older-portfolio branch subtracts the *old*
+        weights from their own drifted values (reference
+        ``portfolio.py:109-121``), i.e. measures drift rather than
+        trading — inconsistent with its other branch. Both branches here
+        compare the drifted old portfolio against the *newer* portfolio's
+        weights (SURVEY.md section 2, quirks-to-fix list).
+        """
+        if portfolio.rebalancing_date is not None and portfolio.rebalancing_date < self.rebalancing_date:
+            w_init = portfolio.initial_weights(
+                selection=self.weights.keys(),
+                return_series=return_series,
+                end_date=self.rebalancing_date,
+                rescale=rescale,
+            )
+            new_weights = self.weights
+        else:
+            w_init = self.initial_weights(
+                selection=portfolio.weights.keys(),
+                return_series=return_series,
+                end_date=portfolio.rebalancing_date,
+                rescale=rescale,
+            )
+            new_weights = portfolio.weights
+        return pd.Series(w_init).sub(pd.Series(new_weights), fill_value=0).abs().sum()
+
+
+class Strategy:
+
+    def __init__(self, portfolios: list):
+        self.portfolios = portfolios
+
+    @property
+    def portfolios(self):
+        return self._portfolios
+
+    @portfolios.setter
+    def portfolios(self, new_portfolios: list):
+        if not isinstance(new_portfolios, list):
+            raise TypeError("portfolios must be a list")
+        if not all(isinstance(p, Portfolio) for p in new_portfolios):
+            raise TypeError("all elements in portfolios must be of type Portfolio")
+        self._portfolios = new_portfolios
+
+    def clear(self) -> None:
+        self.portfolios.clear()
+
+    def get_rebalancing_dates(self):
+        return [portfolio.rebalancing_date for portfolio in self.portfolios]
+
+    def get_weights(self, rebalancing_date: str):
+        for portfolio in self.portfolios:
+            if portfolio.rebalancing_date == rebalancing_date:
+                return portfolio.weights
+        return None
+
+    def get_weights_df(self) -> pd.DataFrame:
+        weights_dict = {p.rebalancing_date: p.weights for p in self.portfolios}
+        return pd.DataFrame(weights_dict).T
+
+    def get_portfolio(self, rebalancing_date: str) -> Portfolio:
+        if rebalancing_date in self.get_rebalancing_dates():
+            idx = self.get_rebalancing_dates().index(rebalancing_date)
+            return self.portfolios[idx]
+        raise ValueError(f"No portfolio found for rebalancing date {rebalancing_date}")
+
+    def has_previous_portfolio(self, rebalancing_date: str) -> bool:
+        dates = self.get_rebalancing_dates()
+        return len(dates) > 0 and dates[0] < rebalancing_date
+
+    def get_previous_portfolio(self, rebalancing_date: str) -> Portfolio:
+        if not self.has_previous_portfolio(rebalancing_date):
+            return Portfolio.empty()
+        yesterday = [x for x in self.get_rebalancing_dates() if x < rebalancing_date][-1]
+        return self.get_portfolio(yesterday)
+
+    def get_initial_portfolio(self, rebalancing_date: str) -> Portfolio:
+        if self.has_previous_portfolio(rebalancing_date=rebalancing_date):
+            return self.get_previous_portfolio(rebalancing_date)
+        return Portfolio(rebalancing_date=None, weights={})
+
+    def __repr__(self):
+        return f"Strategy(portfolios={self.portfolios})"
+
+    def number_of_assets(self, th: float = 0.0001) -> pd.Series:
+        return self.get_weights_df().apply(lambda x: sum(np.abs(x) > th), axis=1)
+
+    def turnover(self, return_series, rescale=True) -> pd.Series:
+        dates = self.get_rebalancing_dates()
+        turnover = {}
+        for rebalancing_date in dates:
+            previous_portfolio = self.get_previous_portfolio(rebalancing_date)
+            current_portfolio = self.get_portfolio(rebalancing_date)
+            if previous_portfolio.rebalancing_date is None:
+                # First rebalance: the full initial acquisition is traded.
+                # (The reference's empty-previous branch degenerates to 0
+                # through a None end_date — SURVEY.md section 2.)
+                turnover[rebalancing_date] = (
+                    pd.Series(current_portfolio.weights).abs().sum()
+                )
+                continue
+            turnover[rebalancing_date] = current_portfolio.turnover(
+                portfolio=previous_portfolio,
+                return_series=return_series,
+                rescale=rescale,
+            )
+        return pd.Series(turnover)
+
+    def simulate(self,
+                 return_series=None,
+                 fc: float = 0,
+                 vc: float = 0,
+                 n_days_per_year: int = 252) -> pd.Series:
+        """Pandas return engine (reference ``portfolio.py:205-245`` parity).
+
+        For the device-vectorized equivalent see
+        :func:`porqua_tpu.accounting.simulate`.
+        """
+        rebdates = self.get_rebalancing_dates()
+        ret_list = []
+        for rebdate in rebdates:
+            next_rebdate = (
+                rebdates[rebdates.index(rebdate) + 1]
+                if rebdate < rebdates[-1]
+                else return_series.index[-1]
+            )
+            portfolio = self.get_portfolio(rebdate)
+            w_float = portfolio.float_weights(
+                return_series=return_series, end_date=next_rebdate, rescale=False
+            )
+            short_positions = [v for v in portfolio.weights.values() if v < 0]
+            long_positions = [v for v in portfolio.weights.values() if v >= 0]
+            margin = abs(sum(short_positions))
+            cash = max(min(1 - sum(long_positions), 1), 0)
+            loan = 1 - (sum(long_positions) + cash) - (sum(short_positions) + margin)
+            w_float.insert(0, "margin", margin)
+            w_float.insert(0, "cash", cash)
+            w_float.insert(0, "loan", loan)
+            level = w_float.sum(axis=1)
+            ret_list.append(level.pct_change(1))
+
+        portf_ret = pd.concat(ret_list).dropna()
+
+        if vc != 0:
+            to = self.turnover(return_series=return_series, rescale=False)
+            varcost = to * vc
+            portf_ret.iloc[0] -= varcost.iloc[0]
+            portf_ret[varcost[1:].index] -= varcost[1:].values
+        if fc != 0:
+            n_days = (
+                (portf_ret.index[1:] - portf_ret.index[:-1])
+                .to_numpy()
+                .astype("timedelta64[D]")
+                .astype(int)
+            )
+            fixcost = (1 + fc) ** (n_days / n_days_per_year) - 1
+            portf_ret.iloc[1:] -= fixcost
+
+        return portf_ret
+
+
+def floating_weights(X, w, start_date, end_date, rescale=True):
+    """Drift weights by cumulative returns (reference ``portfolio.py:254-288``)."""
+    start_date = pd.to_datetime(start_date)
+    end_date = pd.to_datetime(end_date)
+    if start_date < X.index[0]:
+        raise ValueError("start_date must be contained in dataset")
+    if end_date > X.index[-1]:
+        raise ValueError("end_date must be contained in dataset")
+
+    w = pd.Series(w, index=w.keys())
+    if w.isna().any():
+        raise ValueError("weights (w) contain NaN which is not allowed.")
+    w = w.to_frame().T
+    xnames = X.columns
+    wnames = w.columns
+    if not all(wnames.isin(xnames)):
+        raise ValueError("Not all assets in w are contained in X.")
+
+    X_tmp = X.loc[start_date:end_date, wnames].copy().fillna(0)
+    xmat = 1 + X_tmp
+    xmat.iloc[0] = w.dropna(how="all").fillna(0)
+    w_float = xmat.cumprod()
+
+    if rescale:
+        w_float_long = (
+            w_float.where(w_float >= 0)
+            .div(w_float[w_float >= 0].abs().sum(axis=1), axis="index")
+            .fillna(0)
+        )
+        w_float_short = (
+            w_float.where(w_float < 0)
+            .div(w_float[w_float < 0].abs().sum(axis=1), axis="index")
+            .fillna(0)
+        )
+        w_float = pd.DataFrame(w_float_long + w_float_short, index=xmat.index, columns=wnames)
+
+    return w_float

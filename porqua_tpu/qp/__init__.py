@@ -1,0 +1,12 @@
+from porqua_tpu.qp.canonical import CanonicalQP, stack_qps
+from porqua_tpu.qp.solve import solve_qp, solve_qp_batch, QPSolution, SolverParams, Status
+
+__all__ = [
+    "CanonicalQP",
+    "stack_qps",
+    "solve_qp",
+    "solve_qp_batch",
+    "QPSolution",
+    "SolverParams",
+    "Status",
+]

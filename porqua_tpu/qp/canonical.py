@@ -1,0 +1,147 @@
+"""Static-shape canonical QP representation.
+
+The canonical problem is
+
+    minimize    0.5 x' P x + q' x + constant
+    subject to  l  <= C x <= u          (m general rows; eq rows have l == u)
+                lb <=   x <= ub         (box, kept separate from C)
+
+This is the OSQP interval form, except the box is *not* materialized as
+identity rows of ``C`` — the ADMM solver handles it implicitly, saving
+an m x n matmul block per iteration and keeping the reduced KKT matrix
+at n x n for the MXU.
+
+Why this shape: the reference lowers each rebalance date to
+``(P, q, G, h, A, b, lb, ub)`` with *data-dependent* row counts
+(reference ``src/constraints.py:114-167``) and hands each problem to a
+C solver one at a time. XLA needs one static shape for the whole batch,
+so problems are padded:
+
+* padded variables get ``lb = ub = 0``, ``q = 0`` and a unit diagonal in
+  ``P`` — they solve to exactly 0 and do not perturb conditioning;
+* padded rows are all-zero with ``l = -inf, u = +inf`` — always
+  satisfied, zero dual.
+
+Padding neutrality comes from this construction alone: padded entries
+contribute exactly zero to every residual and projection, so the solver
+needs no special-casing. ``var_mask``/``row_mask`` mark the real entries
+for *consumers* (extracting weights, reporting universe sizes) — the
+ADMM loop itself does not read them.
+
+A :class:`CanonicalQP` is a NamedTuple of arrays, hence a JAX pytree:
+``vmap``/``scan``/``pjit`` over a leading batch dimension just work.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class CanonicalQP(NamedTuple):
+    """One (or a batch of) canonical QP(s); all fields are arrays.
+
+    Shapes given for a single problem; a batch adds a leading axis.
+    """
+
+    P: jax.Array          # (n, n) objective quadratic (symmetric PSD)
+    q: jax.Array          # (n,)   objective linear
+    C: jax.Array          # (m, n) general constraint rows
+    l: jax.Array          # (m,)   row lower bounds (-inf for pure <=)
+    u: jax.Array          # (m,)   row upper bounds (+inf for pure >=)
+    lb: jax.Array         # (n,)   variable lower bounds
+    ub: jax.Array         # (n,)   variable upper bounds
+    var_mask: jax.Array   # (n,)   1.0 for real variables, 0.0 for padding
+    row_mask: jax.Array   # (m,)   1.0 for real rows, 0.0 for padding
+    constant: jax.Array   # ()     objective constant
+
+    @property
+    def n(self) -> int:
+        return self.P.shape[-1]
+
+    @property
+    def m(self) -> int:
+        return self.C.shape[-2]
+
+    @property
+    def batch_shape(self):
+        return self.P.shape[:-2]
+
+    def objective_value(self, x, with_const: bool = True):
+        """0.5 x'Px + q'x (+ constant); mirrors reference
+        ``qp_problems.py:219-221``."""
+        val = 0.5 * jnp.einsum("...i,...ij,...j->...", x, self.P, x) + jnp.einsum(
+            "...i,...i->...", self.q, x
+        )
+        return val + self.constant if with_const else val
+
+    @staticmethod
+    def build(P: np.ndarray,
+              q: np.ndarray,
+              C: Optional[np.ndarray] = None,
+              l: Optional[np.ndarray] = None,
+              u: Optional[np.ndarray] = None,
+              lb: Optional[np.ndarray] = None,
+              ub: Optional[np.ndarray] = None,
+              constant: float = 0.0,
+              n_max: Optional[int] = None,
+              m_max: Optional[int] = None,
+              dtype=jnp.float32) -> "CanonicalQP":
+        """Assemble + pad a single problem from host-side numpy arrays."""
+        P = np.asarray(P, dtype=np.float64)
+        q = np.asarray(q, dtype=np.float64).reshape(-1)
+        n = q.shape[0]
+        if C is None or C.size == 0:
+            C = np.zeros((0, n))
+            l = np.zeros((0,))
+            u = np.zeros((0,))
+        C = np.asarray(C, dtype=np.float64).reshape(-1, n)
+        l = np.asarray(l, dtype=np.float64).reshape(-1)
+        u = np.asarray(u, dtype=np.float64).reshape(-1)
+        m = C.shape[0]
+        lb = np.full(n, -np.inf) if lb is None else np.asarray(lb, dtype=np.float64)
+        ub = np.full(n, np.inf) if ub is None else np.asarray(ub, dtype=np.float64)
+
+        n_max = n if n_max is None else int(n_max)
+        m_max = m if m_max is None else int(m_max)
+        if n_max < n or m_max < m:
+            raise ValueError(f"padding target ({n_max},{m_max}) smaller than problem ({n},{m})")
+
+        dn, dm = n_max - n, m_max - m
+        P_pad = np.zeros((n_max, n_max))
+        P_pad[:n, :n] = P
+        if dn:
+            P_pad[n:, n:] = np.eye(dn)
+        q_pad = np.concatenate([q, np.zeros(dn)])
+        C_pad = np.zeros((m_max, n_max))
+        C_pad[:m, :n] = C
+        l_pad = np.concatenate([l, np.full(dm, -np.inf)])
+        u_pad = np.concatenate([u, np.full(dm, np.inf)])
+        lb_pad = np.concatenate([lb, np.zeros(dn)])
+        ub_pad = np.concatenate([ub, np.zeros(dn)])
+        var_mask = np.concatenate([np.ones(n), np.zeros(dn)])
+        row_mask = np.concatenate([np.ones(m), np.zeros(dm)])
+
+        as_dev = lambda a: jnp.asarray(a, dtype=dtype)
+        return CanonicalQP(
+            P=as_dev(P_pad), q=as_dev(q_pad), C=as_dev(C_pad),
+            l=as_dev(l_pad), u=as_dev(u_pad), lb=as_dev(lb_pad), ub=as_dev(ub_pad),
+            var_mask=as_dev(var_mask), row_mask=as_dev(row_mask),
+            constant=jnp.asarray(constant, dtype=dtype),
+        )
+
+
+def stack_qps(qps: Sequence[CanonicalQP]) -> CanonicalQP:
+    """Stack same-shape problems into one batch along a new leading axis."""
+    if not qps:
+        raise ValueError("cannot stack an empty sequence of QPs")
+    shapes = {(qp.n, qp.m) for qp in qps}
+    if len(shapes) != 1:
+        raise ValueError(
+            f"all problems must share one padded shape; got {sorted(shapes)}. "
+            "Pass n_max/m_max to CanonicalQP.build."
+        )
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *qps)

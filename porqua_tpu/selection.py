@@ -1,0 +1,90 @@
+"""Named-filter asset selection (mirror of reference ``src/selection.py``).
+
+Each filter is a pandas Series/DataFrame; an asset is selected when all
+binary filters agree (== 1). Host-side: selection decides the *universe
+mask* that the device-side batched backtest consumes as a static-shape
+0/1 vector per rebalance date.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import pandas as pd
+
+
+class Selection:
+
+    def __init__(self, ids: pd.Index = pd.Index([])):
+        self._filtered: dict = {}
+        self.selected = ids
+
+    @property
+    def selected(self) -> pd.Index:
+        return self._selected
+
+    @selected.setter
+    def selected(self, value):
+        if not isinstance(value, pd.Index):
+            raise ValueError(
+                "Inconsistent input type for selected.setter. Needs to be a pd.Index."
+            )
+        self._selected = value
+
+    @property
+    def filtered(self):
+        return self._filtered
+
+    def get_selected(self, filter_names: Optional[list] = None) -> pd.Index:
+        df = self.df_binary(filter_names)
+        return df[df.eq(1).all(axis=1)].index
+
+    def clear(self) -> None:
+        self.selected = pd.Index([])
+        self._filtered = {}
+
+    def add_filtered(self,
+                     filter_name: str,
+                     value: Union[pd.Series, pd.DataFrame]) -> None:
+        if not isinstance(filter_name, str) or not filter_name.strip():
+            raise ValueError("Argument 'filter_name' must be a nonempty string.")
+
+        if not isinstance(value, (pd.Series, pd.DataFrame)):
+            raise ValueError(
+                "Inconsistent input type. Needs to be a pd.Series or a pd.DataFrame."
+            )
+
+        if isinstance(value, pd.Series) and value.name == "binary":
+            if not value.isin([0, 1]).all():
+                raise ValueError("Column 'binary' must contain only 0s and 1s.")
+            value = value.astype(int)
+
+        if isinstance(value, pd.DataFrame) and "binary" in value.columns:
+            if not value["binary"].isin([0, 1]).all():
+                raise ValueError("Column 'binary' must contain only 0s and 1s.")
+            value["binary"] = value["binary"].astype(int)
+
+        self._filtered[filter_name] = value
+        self.selected = self.get_selected()
+
+    def df(self, filter_names: Optional[list] = None) -> pd.DataFrame:
+        if filter_names is None:
+            filter_names = self.filtered.keys()
+        return pd.concat(
+            {
+                key: (
+                    pd.DataFrame(self.filtered[key])
+                    if isinstance(self.filtered[key], pd.Series)
+                    else self.filtered[key]
+                )
+                for key in filter_names
+            },
+            axis=1,
+        )
+
+    def df_binary(self, filter_names: Optional[list] = None) -> pd.DataFrame:
+        if filter_names is None:
+            filter_names = self.filtered.keys()
+        df = self.df(filter_names=filter_names).filter(like="binary").dropna()
+        df.columns = df.columns.droplevel(1)
+        return df

@@ -1,0 +1,11 @@
+from porqua_tpu.utils.psd import is_psd, nearest_psd, project_psd
+from porqua_tpu.utils.helpers import to_numpy, serialize_solution, output_to_strategies
+
+__all__ = [
+    "is_psd",
+    "nearest_psd",
+    "project_psd",
+    "to_numpy",
+    "serialize_solution",
+    "output_to_strategies",
+]

@@ -1,0 +1,76 @@
+"""Small host-side helpers (mirror of reference ``src/helper_functions.py``).
+
+The numerical PSD helpers live in :mod:`porqua_tpu.utils.psd`; this module
+keeps the data-munging utilities.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any
+
+import numpy as np
+
+
+def to_numpy(data):
+    """``None``-safe conversion to numpy (reference ``helper_functions.py:82``)."""
+    if data is None:
+        return None
+    if hasattr(data, "to_numpy"):
+        return data.to_numpy()
+    return np.asarray(data)
+
+
+def serialize_solution(name_suffix: str, solution: Any, runtime: float) -> None:
+    """Pickle a solver solution + quality metrics.
+
+    Mirror of reference ``helper_functions.py:69-80`` adapted to our
+    :class:`~porqua_tpu.qp.solve.QPSolution` (which carries residuals as
+    fields rather than methods).
+    """
+    result = {
+        "solution": np.asarray(solution.x),
+        "objective": float(solution.obj_val),
+        "primal_residual": float(solution.prim_res),
+        "dual_residual": float(solution.dual_res),
+        "duality_gap": float(solution.duality_gap),
+        "runtime": runtime,
+    }
+    with open(f"{name_suffix}.pickle", "wb") as handle:
+        pickle.dump(result, handle, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def output_to_strategies(output: dict):
+    """Convert percentile-backtest output into per-quantile strategies.
+
+    Mirror of reference ``helper_functions.py:86-99``: ``output`` maps
+    rebalance date -> {'weights_1': Series, ..., 'weights_K': Series}.
+    """
+    from porqua_tpu.portfolio import Portfolio, Strategy
+
+    first = output[list(output.keys())[0]]
+    n_quantiles = len([k for k in first.keys() if k.startswith("weights_")])
+    strategy_dict = {}
+    for i in range(n_quantiles):
+        strategy = Strategy([])
+        for rebdate in output.keys():
+            weights = output[rebdate][f"weights_{i + 1}"]
+            if hasattr(weights, "to_dict"):
+                weights = weights.to_dict()
+            strategy.portfolios.append(Portfolio(rebdate, weights))
+        strategy_dict[f"q{i + 1}"] = strategy
+    return strategy_dict
+
+
+def calculate_rmse(y_true, y_pred) -> float:
+    """Root mean squared error (reference ``helper_functions.py:105-110``)."""
+    y_true = np.asarray(y_true, dtype=float)
+    y_pred = to_numpy(y_pred).astype(float)
+    return float(np.sqrt(np.mean((y_true - y_pred) ** 2)))
+
+
+def calculate_mape(y_true, y_pred) -> float:
+    """Mean absolute percentage error (reference ``helper_functions.py:113-119``)."""
+    y_true = np.asarray(y_true, dtype=float)
+    y_pred = to_numpy(y_pred).astype(float)
+    return float(np.mean(np.abs((y_true - y_pred) / y_true)) * 100)

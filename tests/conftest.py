@@ -1,0 +1,35 @@
+"""Test configuration: CPU backend with 8 virtual devices.
+
+XLA's CPU backend runs the same programs as TPU (the "fake backend" the
+reference never had — SURVEY.md section 4), and 8 virtual host devices
+let the multi-chip sharding paths compile and execute without hardware.
+x64 is enabled so parity tests can run the solver at float64 against
+float64 references; solver code is dtype-parametric.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The environment's sitecustomize registers the axon TPU plugin and sets
+# jax_platforms="axon,cpu" via jax.config — which overrides any
+# JAX_PLATFORMS env var. Tests must run on the virtual-device CPU
+# backend, so the config (not the env) is the knob to set here.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import sys  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

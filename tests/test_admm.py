@@ -1,0 +1,208 @@
+"""ADMM solver correctness: parity vs scipy references and KKT checks.
+
+This is the automated port of the reference's cross-solver validation
+harness (``example/compare_solver.ipynb`` cells 6/8/12): the same
+problem is solved by the TPU-native ADMM solver and an independent CPU
+reference, comparing solutions, objective values, and primal/dual
+residuals. qpsolvers/cvxopt are not available in this environment, so
+the references are scipy (L-BFGS-B / SLSQP / linprog-HiGHS) and analytic
+KKT solutions.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+import scipy.optimize
+
+from porqua_tpu.qp.canonical import CanonicalQP, stack_qps
+from porqua_tpu.qp.solve import solve_qp, solve_qp_batch, SolverParams, Status
+
+F64 = jnp.float64
+TIGHT = SolverParams(eps_abs=1e-9, eps_rel=1e-9, max_iter=20000)
+
+
+def random_psd(rng, n, cond=10.0):
+    Q, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    eigs = np.logspace(0, np.log10(cond), n)
+    return (Q * eigs) @ Q.T
+
+
+def test_unconstrained():
+    """No active constraints: solution is -P^{-1} q."""
+    rng = np.random.default_rng(0)
+    n = 8
+    P = random_psd(rng, n)
+    q = rng.standard_normal(n)
+    qp = CanonicalQP.build(P, q, dtype=F64)
+    sol = solve_qp(qp, TIGHT)
+    assert int(sol.status) == Status.SOLVED
+    np.testing.assert_allclose(np.asarray(sol.x), -np.linalg.solve(P, q), atol=1e-6)
+
+
+def test_equality_constrained_analytic():
+    """Eq-constrained QP vs the analytic KKT solution."""
+    rng = np.random.default_rng(1)
+    n, me = 10, 3
+    P = random_psd(rng, n)
+    q = rng.standard_normal(n)
+    A = rng.standard_normal((me, n))
+    b = rng.standard_normal(me)
+    qp = CanonicalQP.build(P, q, C=A, l=b, u=b, dtype=F64)
+    sol = solve_qp(qp, TIGHT)
+    assert int(sol.status) == Status.SOLVED
+
+    kkt = np.block([[P, A.T], [A, np.zeros((me, me))]])
+    ref = np.linalg.solve(kkt, np.concatenate([-q, b]))
+    np.testing.assert_allclose(np.asarray(sol.x), ref[:n], atol=1e-6)
+    # Dual parity too (sign convention: P x + q + A' y = 0)
+    np.testing.assert_allclose(np.asarray(sol.y[:me]), ref[n:], atol=1e-5)
+
+
+def test_box_constrained_vs_lbfgsb():
+    rng = np.random.default_rng(2)
+    n = 12
+    P = random_psd(rng, n, cond=100.0)
+    q = rng.standard_normal(n) * 3
+    lb, ub = -0.3 * np.ones(n), 0.4 * np.ones(n)
+    qp = CanonicalQP.build(P, q, lb=lb, ub=ub, dtype=F64)
+    sol = solve_qp(qp, TIGHT)
+    assert int(sol.status) == Status.SOLVED
+
+    ref = scipy.optimize.minimize(
+        lambda x: 0.5 * x @ P @ x + q @ x,
+        x0=np.zeros(n),
+        jac=lambda x: P @ x + q,
+        bounds=list(zip(lb, ub)),
+        method="L-BFGS-B",
+        options={"ftol": 1e-15, "gtol": 1e-12, "maxiter": 5000},
+    )
+    np.testing.assert_allclose(np.asarray(sol.x), ref.x, atol=1e-6)
+
+
+def portfolio_qp(rng, n, dtype=F64, n_max=None, m_max=None):
+    """Long-only fully-invested min-variance-style problem."""
+    X = rng.standard_normal((80, n)) * 0.01
+    P = 2 * X.T @ X + 1e-4 * np.eye(n)
+    q = -0.01 * rng.random(n)
+    C = np.ones((1, n))
+    return CanonicalQP.build(
+        P, q, C=C, l=np.ones(1), u=np.ones(1),
+        lb=np.zeros(n), ub=np.ones(n), dtype=dtype,
+        n_max=n_max, m_max=m_max,
+    ), P, q
+
+
+def test_portfolio_vs_slsqp():
+    rng = np.random.default_rng(3)
+    n = 15
+    qp, P, q = portfolio_qp(rng, n)
+    sol = solve_qp(qp, TIGHT)
+    assert int(sol.status) == Status.SOLVED
+    assert float(jnp.sum(sol.x)) == pytest.approx(1.0, abs=1e-7)
+    assert float(jnp.min(sol.x)) >= -1e-8
+
+    ref = scipy.optimize.minimize(
+        lambda x: 0.5 * x @ P @ x + q @ x,
+        x0=np.ones(n) / n,
+        jac=lambda x: P @ x + q,
+        bounds=[(0, 1)] * n,
+        constraints=[{"type": "eq", "fun": lambda x: x.sum() - 1,
+                      "jac": lambda x: np.ones(n)}],
+        method="SLSQP",
+        options={"ftol": 1e-14, "maxiter": 1000},
+    )
+    assert float(sol.obj_val) <= ref.fun + 1e-8
+    np.testing.assert_allclose(np.asarray(sol.x), ref.x, atol=1e-5)
+
+
+def test_padded_solution_matches_unpadded():
+    rng = np.random.default_rng(4)
+    n = 10
+    qp, _, _ = portfolio_qp(rng, n)
+    rng = np.random.default_rng(4)
+    qp_pad, _, _ = portfolio_qp(rng, n, n_max=16, m_max=6)
+    sol = solve_qp(qp, TIGHT)
+    sol_pad = solve_qp(qp_pad, TIGHT)
+    assert int(sol_pad.status) == Status.SOLVED
+    np.testing.assert_allclose(
+        np.asarray(sol_pad.x[:n]), np.asarray(sol.x), atol=1e-7
+    )
+    np.testing.assert_allclose(np.asarray(sol_pad.x[n:]), 0.0, atol=1e-9)
+
+
+def test_lp_vs_linprog():
+    """P = 0 (pure LP, the LAD case) vs scipy's HiGHS."""
+    rng = np.random.default_rng(5)
+    n, m = 8, 5
+    c = rng.random(n) + 0.1
+    G = rng.standard_normal((m, n))
+    h = rng.random(m) + 1.0
+    qp = CanonicalQP.build(
+        np.zeros((n, n)), c,
+        C=G, l=np.full(m, -np.inf), u=h,
+        lb=np.zeros(n), ub=np.ones(n), dtype=F64,
+    )
+    sol = solve_qp(qp, TIGHT)
+    assert int(sol.status) == Status.SOLVED
+    ref = scipy.optimize.linprog(c, A_ub=G, b_ub=h, bounds=[(0, 1)] * n)
+    assert ref.status == 0
+    assert float(sol.obj_val) == pytest.approx(ref.fun, abs=1e-6)
+
+
+def test_batch_matches_single():
+    rng = np.random.default_rng(6)
+    qps = [portfolio_qp(rng, 12)[0] for _ in range(4)]
+    batch = stack_qps(qps)
+    bsol = solve_qp_batch(batch, TIGHT)
+    for i, qp in enumerate(qps):
+        s = solve_qp(qp, TIGHT)
+        np.testing.assert_allclose(
+            np.asarray(bsol.x[i]), np.asarray(s.x), atol=1e-6
+        )
+        assert int(bsol.status[i]) == Status.SOLVED
+
+
+def test_primal_infeasible():
+    """x >= 1 and x <= 0 simultaneously."""
+    n = 4
+    C = np.vstack([np.eye(n), np.eye(n)])
+    l = np.concatenate([np.ones(n), np.full(n, -np.inf)])
+    u = np.concatenate([np.full(n, np.inf), np.zeros(n)])
+    qp = CanonicalQP.build(np.eye(n), np.zeros(n), C=C, l=l, u=u, dtype=F64)
+    sol = solve_qp(qp, SolverParams(max_iter=4000))
+    assert int(sol.status) == Status.PRIMAL_INFEASIBLE
+
+
+def test_dual_infeasible():
+    """Unbounded below: min -x, x >= 0 only."""
+    n = 3
+    qp = CanonicalQP.build(
+        np.zeros((n, n)), -np.ones(n),
+        lb=np.zeros(n), ub=np.full(n, np.inf), dtype=F64,
+    )
+    sol = solve_qp(qp, SolverParams(max_iter=4000))
+    assert int(sol.status) == Status.DUAL_INFEASIBLE
+
+
+def test_float32_accuracy():
+    """f32 (the TPU path) with polish should still give ~1e-4 accuracy."""
+    rng = np.random.default_rng(7)
+    n = 20
+    qp64, P, q = portfolio_qp(rng, n, dtype=F64)
+    rng = np.random.default_rng(7)
+    qp32, _, _ = portfolio_qp(rng, n, dtype=jnp.float32)
+    ref = solve_qp(qp64, TIGHT)
+    sol = solve_qp(qp32, SolverParams(eps_abs=1e-6, eps_rel=1e-6, max_iter=10000))
+    assert int(sol.status) == Status.SOLVED
+    np.testing.assert_allclose(
+        np.asarray(sol.x), np.asarray(ref.x), atol=5e-4
+    )
+
+
+def test_warm_start_reduces_iterations():
+    rng = np.random.default_rng(8)
+    qp, _, _ = portfolio_qp(rng, 15)
+    cold = solve_qp(qp, TIGHT)
+    warm = solve_qp(qp, TIGHT, x0=cold.x, y0=cold.y)
+    assert int(warm.iters) <= int(cold.iters)
+    np.testing.assert_allclose(np.asarray(warm.x), np.asarray(cold.x), atol=1e-6)

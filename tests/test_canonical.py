@@ -1,0 +1,62 @@
+"""Canonical-form construction, padding and stacking."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from porqua_tpu.qp.canonical import CanonicalQP, stack_qps
+
+
+def _toy(n=3, m=2, n_max=None, m_max=None):
+    P = np.eye(n)
+    q = np.arange(1.0, n + 1)
+    C = np.ones((m, n))
+    l = np.zeros(m)
+    u = np.ones(m)
+    return CanonicalQP.build(P, q, C, l, u, lb=np.zeros(n), ub=np.ones(n),
+                             n_max=n_max, m_max=m_max, dtype=jnp.float64)
+
+
+def test_build_shapes():
+    qp = _toy()
+    assert qp.n == 3 and qp.m == 2
+    assert qp.P.shape == (3, 3)
+    assert qp.C.shape == (2, 3)
+
+
+def test_padding():
+    qp = _toy(n=3, m=2, n_max=5, m_max=4)
+    assert qp.n == 5 and qp.m == 4
+    # Padded vars: unit diag, pinned to 0
+    assert float(qp.P[4, 4]) == 1.0
+    assert float(qp.lb[4]) == 0.0 and float(qp.ub[4]) == 0.0
+    assert float(qp.var_mask[3]) == 0.0
+    # Padded rows: always-satisfied intervals
+    assert np.isinf(float(qp.l[3])) and np.isinf(float(qp.u[3]))
+    assert float(qp.row_mask[2]) == 0.0
+    # Real data intact
+    np.testing.assert_allclose(np.asarray(qp.P[:3, :3]), np.eye(3))
+
+
+def test_padding_too_small_raises():
+    with pytest.raises(ValueError):
+        _toy(n=3, m=2, n_max=2)
+
+
+def test_objective_value():
+    qp = _toy()
+    x = jnp.array([1.0, 0.0, 0.0])
+    # 0.5 * 1 + q[0] * 1 = 1.5
+    assert float(qp.objective_value(x)) == pytest.approx(1.5)
+
+
+def test_stack():
+    qps = [_toy(n_max=4, m_max=3) for _ in range(5)]
+    batch = stack_qps(qps)
+    assert batch.P.shape == (5, 4, 4)
+    assert batch.l.shape == (5, 3)
+
+
+def test_stack_shape_mismatch():
+    with pytest.raises(ValueError):
+        stack_qps([_toy(), _toy(n_max=5, m_max=4)])
