@@ -83,14 +83,41 @@ def admm_cpu(P, q, lb, ub, rho=0.1, sigma=1e-6, alpha=1.6,
 
 
 def run_baseline(Xs_np, ys_np, n_sample):
-    """Serial CPU solves over a sample of dates; returns (total_s, tes)."""
+    """Serial CPU solves over a sample of dates; returns (total_s, tes).
+
+    Prefers the compiled C++ ADMM core (porqua_tpu/native) — the
+    stand-in for the reference's compiled qpsolvers backends; falls back
+    to the numpy implementation if the toolchain is unavailable.
+    """
+    solver = None
+    try:
+        from porqua_tpu.native import solve_qp_native
+
+        def solver(P, q, n):
+            sol = solve_qp_native(
+                P, q, np.ones((1, n)), np.ones(1), np.ones(1),
+                np.zeros(n), np.ones(n), eps_abs=1e-5, eps_rel=1e-5,
+            )
+            return sol.x
+        solver(np.eye(4), np.zeros(4), 4)  # force the one-time g++ build
+        label = "serial C++-ADMM CPU"
+        log("baseline: native C++ ADMM core")
+    except Exception as e:  # pragma: no cover - toolchain-dependent
+        log(f"baseline: native build failed ({e}); using numpy ADMM")
+        label = "serial numpy-ADMM CPU"
+
+        def solver(P, q, n):
+            x, _ = admm_cpu(P, q, 0.0, 1.0)
+            return x
+
+    run_baseline.label = label
     times, tes = [], []
     for i in range(n_sample):
         X, y = Xs_np[i], ys_np[i]
         t0 = time.perf_counter()
         P = 2.0 * (X.T @ X)
         q = -2.0 * (X.T @ y)
-        x, iters = admm_cpu(P, q, 0.0, 1.0)
+        x = solver(P, q, X.shape[1])
         times.append(time.perf_counter() - t0)
         tes.append(float(np.sqrt(np.mean((X @ x - y) ** 2))))
     return float(np.sum(times)), tes
@@ -155,7 +182,7 @@ def main():
     print(json.dumps({
         "metric": f"index-replication backtest wall-clock "
                   f"({N_DATES} dates x {N_ASSETS} assets, batched ADMM on-device "
-                  f"vs serial numpy-ADMM CPU)",
+                  f"vs {getattr(run_baseline, 'label', 'serial CPU')})",
         "value": round(tpu_s, 4),
         "unit": "seconds",
         "vs_baseline": round(base_s / tpu_s, 2),

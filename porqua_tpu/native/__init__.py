@@ -1,0 +1,137 @@
+"""Native C++ QP solver: build + ctypes binding.
+
+The TPU framework's counterpart to the compiled solver backends the
+reference reaches through ``qpsolvers`` (reference
+``src/qp_problems.py:211``). The C++ core (``qp_solver.cpp``) runs the
+same ADMM splitting as the JAX device solver, serially, one problem per
+call — the reference's execution model — which makes it both the
+honest CPU baseline for ``bench.py`` and an independent implementation
+for cross-checking the device solver.
+
+The shared library is built on first use with g++ (no external
+dependencies) and cached next to the source; ``ctypes`` provides the
+binding (pybind11 is not available in this image).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "qp_solver.cpp")
+_SO = os.path.join(_DIR, "libporqua_qp.so")
+_lock = threading.Lock()
+_lib = None
+
+
+def build_library(force: bool = False) -> str:
+    """Compile qp_solver.cpp to a shared library (cached)."""
+    with _lock:
+        if force or not os.path.exists(_SO) or (
+            os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+        ):
+            cmd = [
+                "g++", "-O3", "-march=native", "-fPIC", "-shared",
+                "-std=c++17", _SRC, "-o", _SO,
+            ]
+            subprocess.run(cmd, check=True, capture_output=True)
+    return _SO
+
+
+def _load():
+    global _lib
+    if _lib is None:
+        lib = ctypes.CDLL(build_library())
+        fn = lib.porqua_solve_qp
+        d = ctypes.POINTER(ctypes.c_double)
+        fn.restype = ctypes.c_int32
+        fn.argtypes = [d, d, d, d, d, d, d,
+                       ctypes.c_int32, ctypes.c_int32,
+                       ctypes.c_double, ctypes.c_double,
+                       ctypes.c_int32, ctypes.c_int32,
+                       ctypes.c_double, ctypes.c_double,
+                       ctypes.c_double, ctypes.c_double,
+                       d, d, d, d]
+        _lib = lib
+    return _lib
+
+
+class NativeSolution(NamedTuple):
+    x: np.ndarray
+    y: np.ndarray
+    mu: np.ndarray
+    status: int          # porqua_tpu.qp.admm.Status codes
+    iters: int
+    prim_res: float
+    dual_res: float
+    obj_val: float
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+
+
+def solve_qp_native(P: np.ndarray,
+                    q: np.ndarray,
+                    C: Optional[np.ndarray] = None,
+                    l: Optional[np.ndarray] = None,
+                    u: Optional[np.ndarray] = None,
+                    lb: Optional[np.ndarray] = None,
+                    ub: Optional[np.ndarray] = None,
+                    eps_abs: float = 1e-8,
+                    eps_rel: float = 1e-8,
+                    max_iter: int = 20000,
+                    check_interval: int = 25,
+                    rho0: float = 0.1,
+                    rho_eq_scale: float = 1e3,
+                    sigma: float = 1e-6,
+                    alpha: float = 1.6) -> NativeSolution:
+    """Solve one dense QP with the C++ ADMM core."""
+    q = np.ascontiguousarray(q, dtype=np.float64).reshape(-1)
+    n = q.shape[0]
+    P = np.ascontiguousarray(P, dtype=np.float64).reshape(n, n)
+    if C is None or np.size(C) == 0:
+        C = np.zeros((0, n))
+        l = np.zeros(0)
+        u = np.zeros(0)
+    C = np.ascontiguousarray(C, dtype=np.float64).reshape(-1, n)
+    m = C.shape[0]
+    l = np.ascontiguousarray(l, dtype=np.float64).reshape(-1)
+    u = np.ascontiguousarray(u, dtype=np.float64).reshape(-1)
+    if l.shape[0] != m or u.shape[0] != m:
+        raise ValueError(
+            f"l/u must have one entry per constraint row: m={m}, "
+            f"got l={l.shape[0]}, u={u.shape[0]}"
+        )
+    # Scalars broadcast to the full box (raw pointers cross the ABI —
+    # lengths must be exact).
+    lb = (np.full(n, -np.inf) if lb is None
+          else np.ascontiguousarray(np.broadcast_to(lb, (n,)), dtype=np.float64))
+    ub = (np.full(n, np.inf) if ub is None
+          else np.ascontiguousarray(np.broadcast_to(ub, (n,)), dtype=np.float64))
+
+    out_x = np.empty(n)
+    out_y = np.empty(max(m, 1))
+    out_mu = np.empty(n)
+    out_info = np.empty(4)
+
+    status = _load().porqua_solve_qp(
+        _ptr(P), _ptr(q), _ptr(C), _ptr(l), _ptr(u), _ptr(lb), _ptr(ub),
+        n, m, eps_abs, eps_rel, max_iter, check_interval,
+        rho0, rho_eq_scale, sigma, alpha,
+        _ptr(out_x), _ptr(out_y), _ptr(out_mu), _ptr(out_info),
+    )
+    return NativeSolution(
+        x=out_x, y=out_y[:m], mu=out_mu,
+        status=int(status),
+        iters=int(out_info[0]),
+        prim_res=float(out_info[1]),
+        dual_res=float(out_info[2]),
+        obj_val=float(out_info[3]),
+    )

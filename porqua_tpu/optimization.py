@@ -176,13 +176,11 @@ class Optimization(ABC):
     def model_canonical(self) -> CanonicalQP:
         """Lower to a padded :class:`CanonicalQP` (device-ready)."""
         parts = self.canonical_parts()
-        dtype = self.params.get("dtype")
-        build_kwargs = {} if dtype is None else {"dtype": dtype}
         self.model = CanonicalQP.build(
             parts["P"], parts["q"], C=parts["C"], l=parts["l"], u=parts["u"],
             lb=parts["lb"], ub=parts["ub"], constant=parts["constant"],
             n_max=self.params.get("n_max"), m_max=self.params.get("m_max"),
-            **build_kwargs,
+            dtype=self.params.get("dtype"),
         )
         return self.model
 
@@ -407,6 +405,7 @@ class LAD(Optimization):
             parts["P"], parts["q"], C=parts["C"], l=parts["l"], u=parts["u"],
             lb=parts["lb"], ub=parts["ub"],
             n_max=self.params.get("n_max"), m_max=self.params.get("m_max"),
+            dtype=self.params.get("dtype"),
         )
         return self.model
 

@@ -89,8 +89,11 @@ class CanonicalQP(NamedTuple):
               constant: float = 0.0,
               n_max: Optional[int] = None,
               m_max: Optional[int] = None,
-              dtype=jnp.float32) -> "CanonicalQP":
-        """Assemble + pad a single problem from host-side numpy arrays."""
+              dtype=None) -> "CanonicalQP":
+        """Assemble + pad a single problem from host-side numpy arrays.
+
+        ``dtype=None`` means float32 (the TPU default)."""
+        dtype = jnp.float32 if dtype is None else dtype
         P = np.asarray(P, dtype=np.float64)
         q = np.asarray(q, dtype=np.float64).reshape(-1)
         n = q.shape[0]

@@ -1,0 +1,120 @@
+"""Constraints DSL shape contract + canonical lowering equivalence.
+
+Port of the reference's only pure unit test
+(``test/tests_quadratic_program.py:28-58``): budget + box + mixed-sense
+linear rows on a 24-asset universe, asserting exact ``to_GhAb`` output
+shapes with and without box-to-G folding — plus checks the reference
+never had: row *content* (sign flips), the interval-form lowering, and
+L1 recording.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from porqua_tpu.constraints import Constraints, box_constraint, match_arg
+
+
+@pytest.fixture
+def universe():
+    return [f"A{i:02d}" for i in range(24)]
+
+
+@pytest.fixture
+def cons(universe):
+    c = Constraints(selection=universe)
+    c.add_budget()                       # sum w  = 1      -> A row
+    c.add_box("LongOnly", upper=0.2)     # 0 <= w <= 0.2   -> lb/ub
+    n = len(universe)
+    A = pd.DataFrame(
+        np.vstack([np.eye(n)[0], np.eye(n)[1], np.eye(n)[2],
+                   np.ones(n), np.eye(n)[5]]),
+        columns=universe,
+    )
+    c.add_linear(
+        Amat=A,
+        sense=pd.Series(["=", "=", "=", "<=", ">="]),
+        rhs=pd.Series([0.1, 0.1, 0.05, 1.0, 0.01]),
+    )
+    return c
+
+
+def test_to_GhAb_shapes(cons, universe):
+    n = len(universe)
+    out = cons.to_GhAb()
+    # budget(=) + three linear '=' rows -> A: (4, N)
+    assert out["A"].shape == (4, n)
+    assert out["b"].shape == (4,)
+    # one '<=' + one '>=' (flipped) -> G: (2, N)
+    assert out["G"].shape == (2, n)
+    assert out["h"].shape == (2,)
+
+
+def test_to_GhAb_box_folding(cons, universe):
+    n = len(universe)
+    out = cons.to_GhAb(lbub_to_G=True)
+    # [-I; I] box rows prepend the linear inequality rows
+    assert out["G"].shape == (2 + 2 * n, n)
+    np.testing.assert_allclose(out["h"][:n], 0.0)          # -lb
+    np.testing.assert_allclose(out["h"][n:2 * n], 0.2)     # ub
+
+
+def test_geq_rows_are_sign_flipped(cons):
+    out = cons.to_GhAb()
+    # Last G row came from 'w5 >= 0.01' -> '-w5 <= -0.01'
+    assert out["h"][-1] == pytest.approx(-0.01)
+    assert out["G"][-1].sum() == pytest.approx(-1.0)
+
+
+def test_canonical_interval_equivalence(cons, universe):
+    """to_canonical must encode exactly the same polytope: eq rows get
+    l == u, ineq rows get l = -inf."""
+    n = len(universe)
+    qp = cons.to_canonical()
+    assert qp.n == n
+    assert qp.m == 6  # 4 eq + 2 ineq
+    l, u = np.asarray(qp.l), np.asarray(qp.u)
+    np.testing.assert_allclose(l[:4], u[:4])
+    assert np.all(np.isneginf(l[4:]))
+    np.testing.assert_allclose(np.asarray(qp.lb), 0.0)
+    np.testing.assert_allclose(np.asarray(qp.ub), 0.2)
+
+
+def test_budget_overwrite_warns(universe):
+    c = Constraints(selection=universe)
+    c.add_budget()
+    with pytest.warns(UserWarning):
+        c.add_budget(rhs=2)
+    assert c.budget["rhs"] == 2
+
+
+def test_box_validation():
+    assert box_constraint("Unbounded")["lower"] == -np.inf
+    assert box_constraint("LongShort")["lower"] == -1
+    with pytest.raises(ValueError):
+        box_constraint("LongOnly", lower=[-0.5, 0.0])
+
+
+def test_match_arg_partial():
+    assert match_arg("Long", ["LongOnly", "Unbounded"]) == "LongOnly"
+    with pytest.raises(ValueError):
+        match_arg("Short", ["LongOnly"])
+
+
+def test_add_linear_via_a_values(universe):
+    c = Constraints(selection=universe)
+    c.add_linear(a_values=pd.Series({"A00": 1.0, "A05": -1.0}),
+                 sense="<=", rhs=0.0, name="spread")
+    out = c.to_GhAb()
+    assert out["G"].shape == (1, len(universe))
+    assert out["G"][0, 0] == 1.0 and out["G"][0, 5] == -1.0
+    # Unnamed assets fill with zeros
+    assert out["G"][0, 1] == 0.0
+
+
+def test_add_l1_records(universe):
+    c = Constraints(selection=universe)
+    c.add_l1("turnover", rhs=0.5, x0={"A00": 1.0})
+    assert c.l1["turnover"]["rhs"] == 0.5
+    with pytest.raises(TypeError):
+        c.add_l1("leverage")

@@ -1,0 +1,68 @@
+"""Native C++ ADMM core vs the JAX device solver and analytic references."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from porqua_tpu.native import build_library, solve_qp_native
+from porqua_tpu.qp import SolverParams, Status, solve_qp
+from porqua_tpu.qp.canonical import CanonicalQP
+
+
+def test_builds():
+    path = build_library()
+    import os
+
+    assert os.path.exists(path)
+
+
+def test_native_unconstrained():
+    rng = np.random.default_rng(0)
+    n = 10
+    Q, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    P = (Q * np.logspace(0, 1, n)) @ Q.T
+    q = rng.standard_normal(n)
+    sol = solve_qp_native(P, q)
+    assert sol.status == Status.SOLVED
+    np.testing.assert_allclose(sol.x, -np.linalg.solve(P, q), atol=1e-6)
+
+
+def test_native_matches_device_solver(rng):
+    """Same portfolio QP through the C++ core and the JAX solver."""
+    n = 20
+    X = rng.standard_normal((80, n)) * 0.01
+    P = 2 * X.T @ X + 1e-4 * np.eye(n)
+    q = -0.01 * rng.random(n)
+    C = np.ones((1, n))
+    l = u = np.ones(1)
+    lb, ub = np.zeros(n), np.ones(n)
+
+    native = solve_qp_native(P, q, C, l, u, lb, ub)
+    assert native.status == Status.SOLVED
+    assert abs(native.x.sum() - 1.0) < 1e-6
+
+    qp = CanonicalQP.build(P, q, C=C, l=l, u=u, lb=lb, ub=ub, dtype=jnp.float64)
+    dev = solve_qp(qp, SolverParams(eps_abs=1e-9, eps_rel=1e-9, max_iter=20000))
+    np.testing.assert_allclose(native.x, np.asarray(dev.x), atol=1e-5)
+    assert native.obj_val == pytest.approx(
+        float(dev.obj_val) - float(qp.constant), abs=1e-8
+    )
+
+
+def test_native_box_only(rng):
+    n = 8
+    P = np.eye(n)
+    q = -2.0 * np.ones(n)
+    sol = solve_qp_native(P, q, lb=np.zeros(n), ub=np.full(n, 0.5))
+    assert sol.status == Status.SOLVED
+    np.testing.assert_allclose(sol.x, 0.5, atol=1e-7)  # clipped optimum
+
+
+def test_native_max_iter_reports():
+    n = 4
+    C = np.vstack([np.eye(n), np.eye(n)])
+    l = np.concatenate([np.ones(n), np.full(n, -np.inf)])
+    u = np.concatenate([np.full(n, np.inf), np.zeros(n)])
+    sol = solve_qp_native(np.eye(n), np.zeros(n), C, l, u, max_iter=500)
+    assert sol.status == Status.MAX_ITER  # infeasible -> cannot converge

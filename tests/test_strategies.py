@@ -1,0 +1,153 @@
+"""Optimization strategy classes: objective math + end-to-end solves.
+
+Covers the strategy layer the reference exercises only interactively
+(``src/_quick_and_dirty_interactive_testing.py``): QEQW, MeanVariance,
+WeightedLeastSquares, LAD, PercentilePortfolios.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import jax.numpy as jnp
+
+from porqua_tpu import (
+    LAD,
+    MeanVariance,
+    PercentilePortfolios,
+    QEQW,
+    WeightedLeastSquares,
+)
+from porqua_tpu.constraints import Constraints
+from porqua_tpu.estimators.mean import MeanEstimator
+from porqua_tpu.optimization_data import OptimizationData
+from porqua_tpu.qp import SolverParams
+
+TIGHT = SolverParams(eps_abs=1e-9, eps_rel=1e-9, max_iter=20000)
+
+
+@pytest.fixture
+def market(rng):
+    n = 8
+    X = pd.DataFrame(
+        rng.standard_normal((200, n)) * 0.01,
+        index=pd.bdate_range("2022-01-03", periods=200),
+        columns=[f"A{i}" for i in range(n)],
+    )
+    y = pd.Series(X.to_numpy() @ rng.dirichlet(np.ones(n)), index=X.index)
+    return X, y
+
+
+def constrained(opt, universe):
+    opt.constraints = Constraints(selection=list(universe))
+    opt.constraints.add_budget()
+    opt.constraints.add_box("LongOnly")
+    return opt
+
+
+def test_qeqw_gives_equal_weights(market):
+    """Identity covariance + zero mean under budget/box -> 1/N."""
+    X, y = market
+    opt = constrained(QEQW(dtype=jnp.float64, **TIGHT.__dict__), X.columns)
+    opt.set_objective(OptimizationData(align=False, return_series=X))
+    assert opt.solve()
+    w = np.array(list(opt.results["weights"].values()))
+    np.testing.assert_allclose(w, 1.0 / X.shape[1], atol=1e-7)
+
+
+def test_mean_variance_risk_aversion_monotone(market):
+    """Higher risk aversion -> lower portfolio variance."""
+    X, y = market
+    variances = []
+    for ra in (0.5, 50.0):
+        opt = constrained(
+            MeanVariance(dtype=jnp.float64, risk_aversion=ra, **TIGHT.__dict__),
+            X.columns,
+        )
+        opt.set_objective(OptimizationData(align=False, return_series=X))
+        assert opt.solve()
+        w = np.array(list(opt.results["weights"].values()))
+        variances.append(float(w @ X.cov().to_numpy() @ w))
+    assert variances[1] <= variances[0] + 1e-12
+
+
+def test_weighted_least_squares_objective(market):
+    """P/q must equal the exponentially-weighted normal equations."""
+    X, y = market
+    tau = 20.0
+    opt = WeightedLeastSquares(tau=tau, dtype=jnp.float64, **TIGHT.__dict__)
+    opt.set_objective(OptimizationData(align=False, return_series=X, bm_series=y))
+
+    lam = np.exp(-np.log(2) / tau)
+    wt_tmp = lam ** np.arange(len(X))
+    wt = np.flip(wt_tmp / wt_tmp.sum() * len(wt_tmp))
+    Xv, yv = X.to_numpy(), y.to_numpy()
+    np.testing.assert_allclose(opt.objective["P"], 2 * Xv.T @ (wt[:, None] * Xv), atol=1e-12)
+    np.testing.assert_allclose(opt.objective["q"], -2 * (wt[:, None] * Xv).T @ yv, atol=1e-12)
+
+
+def test_wls_recent_emphasis(market):
+    """With a short half-life, recently-shifted benchmarks move weights
+    toward the recently-correlated asset."""
+    X, y = market
+    y2 = y.copy()
+    y2.iloc[-40:] = X["A0"].iloc[-40:]  # benchmark becomes asset 0 lately
+    opt = constrained(
+        WeightedLeastSquares(tau=10.0, dtype=jnp.float64, **TIGHT.__dict__), X.columns
+    )
+    opt.set_objective(OptimizationData(align=False, return_series=X, bm_series=y2))
+    assert opt.solve()
+    w = opt.results["weights"]
+    assert w["A0"] > 0.8
+
+
+def test_lad_tracks_benchmark(market):
+    X, y = market
+    opt = constrained(
+        LAD(dtype=jnp.float64, use_level=True, use_log=True, **TIGHT.__dict__),
+        X.columns,
+    )
+    opt.set_objective(OptimizationData(align=False, return_series=X, bm_series=y))
+    assert opt.solve()
+    w = np.array(list(opt.results["weights"].values()))
+    assert abs(w.sum() - 1.0) < 1e-6
+    assert w.min() > -1e-8
+    # LAD minimizes the absolute level deviation: it must beat equal weight.
+    lev_X = np.log((1 + X.to_numpy()).cumprod(axis=0))
+    lev_y = np.log((1 + y.to_numpy()).cumprod())
+    dev_lad = np.abs(lev_X @ w - lev_y).sum()
+    dev_eq = np.abs(lev_X @ (np.ones(8) / 8) - lev_y).sum()
+    assert dev_lad <= dev_eq + 1e-9
+
+
+def test_percentile_portfolios_buckets(rng):
+    scores = pd.Series(rng.standard_normal(25), index=[f"S{i}" for i in range(25)])
+    pp = PercentilePortfolios(n_percentiles=5, estimator=MeanEstimator())
+    pp.constraints = Constraints(selection=list(scores.index))
+    X = pd.DataFrame(
+        np.tile(scores.to_numpy(), (30, 1)) * 0.001,
+        columns=scores.index,
+    )
+    pp.set_objective(OptimizationData(align=False, return_series=X))
+    assert pp.solve()
+    w = pd.Series(pp.results["weights"])
+    # Long the top-mean bucket (score negated internally -> bucket 1 =
+    # best), short the bottom; 5 assets in each on a 25-asset universe.
+    assert (w > 0).sum() == 5 and (w < 0).sum() == 5
+    assert w[w > 0].sum() == pytest.approx(1.0)
+    assert w[w < 0].sum() == pytest.approx(-1.0)
+    # The long bucket holds the highest-scoring names.
+    top_names = scores.nlargest(5).index
+    assert set(w[w > 0].index) == set(top_names)
+
+
+def test_percentile_zero_score_noise_deterministic(rng):
+    scores = pd.DataFrame({"s": np.zeros(10)}, index=[f"S{i}" for i in range(10)])
+    outs = []
+    for _ in range(2):
+        pp = PercentilePortfolios(field="s", n_percentiles=5)
+        pp.constraints = Constraints(selection=list(scores.index))
+        pp.set_objective(OptimizationData(align=False, scores=scores))
+        pp.solve()
+        outs.append(pd.Series(pp.results["weights"]))
+    pd.testing.assert_series_equal(outs[0], outs[1])
