@@ -1,0 +1,32 @@
+"""ML-driven asset selection and return-prediction models.
+
+Covers the reference's ML capability surface (``example/lstm.ipynb``,
+``example/ml.ipynb``, ``example/ordinal_regression.ipynb`` and the
+XGBoost LTR bibfn at reference ``src/builders.py:138-180``), rebuilt
+TPU-first: the sequence/regression models train as jitted JAX programs;
+the gradient-boosting LTR surrogate stays host-side, off the hot path,
+exactly where the reference runs it.
+"""
+
+from porqua_tpu.models.ltr import ltr_selection_scores
+
+_LSTM_EXPORTS = (
+    "LSTMRanker",
+    "TrainedLSTM",
+    "train_lstm",
+    "make_windows",
+    "ndcg",
+    "lstm_selection_scores",
+)
+
+__all__ = ["ltr_selection_scores", *_LSTM_EXPORTS]
+
+
+def __getattr__(name):
+    # flax/optax load only when the LSTM surface is actually used, so the
+    # numpy/pandas-only LTR selection path stays importable without them.
+    if name in _LSTM_EXPORTS:
+        from porqua_tpu.models import lstm
+
+        return getattr(lstm, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -116,6 +116,10 @@ def solve_qp_native(P: np.ndarray,
     ub = (np.full(n, np.inf) if ub is None
           else np.ascontiguousarray(np.broadcast_to(ub, (n,)), dtype=np.float64))
 
+    # A non-positive interval would never advance the C loop counter
+    # (the GIL is released inside the call — an uninterruptible hang).
+    check_interval = max(1, int(check_interval))
+
     out_x = np.empty(n)
     out_y = np.empty(max(m, 1))
     out_mu = np.empty(n)

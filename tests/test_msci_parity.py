@@ -9,6 +9,8 @@ defines — primal residual, dual residual, duality gap, constraint
 residuals |Ax-b| / max(Gx-h), and the objective value at the solution.
 """
 
+import os
+
 import numpy as np
 import pytest
 import scipy.optimize
@@ -23,6 +25,11 @@ from porqua_tpu.qp import SolverParams, Status
 
 DATA_PATH = "/root/reference/data/"
 TIGHT = SolverParams(eps_abs=1e-9, eps_rel=1e-9, max_iter=20000)
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(DATA_PATH),
+    reason="reference data mount not present",
+)
 
 
 @pytest.fixture(scope="module")
