@@ -10,23 +10,30 @@ exactly where the reference runs it.
 
 from porqua_tpu.models.ltr import ltr_selection_scores
 
-_LSTM_EXPORTS = (
-    "LSTMRanker",
-    "TrainedLSTM",
-    "train_lstm",
-    "make_windows",
-    "ndcg",
-    "lstm_selection_scores",
-)
+# jax/flax/optax-backed models load lazily so the numpy/pandas-only LTR
+# selection path stays importable without them.
+_LAZY_EXPORTS = {
+    "LSTMRanker": "lstm",
+    "TrainedLSTM": "lstm",
+    "train_lstm": "lstm",
+    "make_windows": "lstm",
+    "ndcg": "lstm",
+    "lstm_selection_scores": "lstm",
+    "OrdinalRegression": "ordinal",
+    "decile_rank_labels": "ordinal",
+    "OLS": "regression",
+    "PCA": "regression",
+    "PCAOLS": "regression",
+    "boosted_regression": "regression",
+}
 
-__all__ = ["ltr_selection_scores", *_LSTM_EXPORTS]
+__all__ = ["ltr_selection_scores", *_LAZY_EXPORTS]
 
 
 def __getattr__(name):
-    # flax/optax load only when the LSTM surface is actually used, so the
-    # numpy/pandas-only LTR selection path stays importable without them.
-    if name in _LSTM_EXPORTS:
-        from porqua_tpu.models import lstm
+    module = _LAZY_EXPORTS.get(name)
+    if module is not None:
+        import importlib
 
-        return getattr(lstm, name)
+        return getattr(importlib.import_module(f"porqua_tpu.models.{module}"), name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -210,7 +210,8 @@ def lstm_selection_scores(bs, rebdate: str,
     scores = model.scores(hist.values[-window:])
 
     universe = list(returns.columns)
-    k = top_k if top_k is not None else len(universe)
+    # same default as the LTR scorer: keep the top half of the universe
+    k = top_k if top_k is not None else max(1, len(universe) // 2)
     ranks = np.argsort(np.argsort(-scores))
     return pd.DataFrame(
         {"values": scores, "binary": (ranks < k).astype(int)},

@@ -24,8 +24,9 @@ import pandas as pd
 
 def _rank_labels(returns: pd.Series, n_bins: int = 10) -> pd.Series:
     """Cross-sectional decile rank labels (0 = worst, n_bins-1 = best)."""
-    pct = returns.rank(pct=True, method="first")
-    return np.minimum((pct * n_bins).astype(int), n_bins - 1)
+    from porqua_tpu.models.labels import rank_labels
+
+    return rank_labels(returns, n_bins=n_bins, ascending=True)
 
 
 def ltr_selection_scores(bs,

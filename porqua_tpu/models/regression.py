@@ -1,0 +1,163 @@
+"""Return-prediction regression workflows: OLS, PCA, PCA+OLS, boosting.
+
+TPU-native equivalent of the reference's per-stock return-prediction
+notebook (reference ``example/ml.ipynb`` cells 5-13): OLS on the firm
+characteristic panel, a PCA scree + PCA(n)+OLS pipeline, and a
+gradient-boosted regressor chosen by grid search. The linear models run
+as jitted JAX programs (lstsq / SVD on device); the boosted model stays
+host-side on sklearn (xgboost is not in this image — same surrogate
+choice as :mod:`porqua_tpu.models.ltr`), off the hot path.
+
+Prediction quality is scored with the RMSE/MAPE helpers the reference
+defines in ``example/ml.ipynb`` cell 1 and ``src/helper_functions.py:105``
+— re-exported here from :mod:`porqua_tpu.utils.helpers`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from porqua_tpu.utils.helpers import calculate_mape, calculate_rmse
+
+__all__ = [
+    "OLS",
+    "PCA",
+    "PCAOLS",
+    "boosted_regression",
+    "calculate_rmse",
+    "calculate_mape",
+]
+
+
+@jax.jit
+def _lstsq_fit(X, y):
+    coef, *_ = jnp.linalg.lstsq(X, y)
+    return coef
+
+
+@dataclasses.dataclass
+class OLS:
+    """Least-squares regression (``sm.OLS`` in the notebook, cell 5).
+
+    ``add_constant=True`` prepends an intercept column — the notebook's
+    (commented) ``sm.add_constant``. Fitting is a jitted ``lstsq`` so a
+    minimum-norm solution exists even for rank-deficient panels.
+    """
+
+    add_constant: bool = False
+    coef_: Optional[np.ndarray] = None
+
+    def _design(self, X):
+        X = jnp.asarray(X, jnp.float32)
+        if self.add_constant:
+            X = jnp.concatenate([jnp.ones((X.shape[0], 1), X.dtype), X], axis=1)
+        return X
+
+    def fit(self, X, y) -> "OLS":
+        self.coef_ = np.asarray(
+            _lstsq_fit(self._design(X), jnp.asarray(y, jnp.float32)))
+        return self
+
+    def predict(self, X) -> np.ndarray:
+        if self.coef_ is None:
+            raise RuntimeError("call fit() first")
+        return np.asarray(self._design(X) @ self.coef_)
+
+
+@dataclasses.dataclass
+class PCA:
+    """Principal components with standardization (notebook cell 8).
+
+    Mirrors ``StandardScaler().fit_transform`` + ``sklearn PCA``: the
+    fit centers/scales each feature, takes the SVD on device, and keeps
+    ``n_components`` right-singular directions; ``explained_variance_ratio_``
+    reproduces the notebook's scree plot data.
+    """
+
+    n_components: int = 15
+    standardize: bool = True
+
+    mean_: Optional[np.ndarray] = None
+    scale_: Optional[np.ndarray] = None
+    components_: Optional[np.ndarray] = None
+    explained_variance_ratio_: Optional[np.ndarray] = None
+
+    def fit(self, X) -> "PCA":
+        X = np.asarray(X, np.float32)
+        self.mean_ = X.mean(axis=0)
+        self.scale_ = (X.std(axis=0, ddof=0) if self.standardize
+                       else np.ones(X.shape[1], np.float32))
+        self.scale_ = np.where(self.scale_ == 0, 1.0, self.scale_)
+        Z = jnp.asarray((X - self.mean_) / self.scale_)
+        _, s, vt = jnp.linalg.svd(Z, full_matrices=False)
+        var = np.asarray(s) ** 2 / max(X.shape[0] - 1, 1)
+        self.explained_variance_ratio_ = var / var.sum()
+        self.components_ = np.asarray(vt[: self.n_components])
+        return self
+
+    def transform(self, X) -> np.ndarray:
+        if self.components_ is None:
+            raise RuntimeError("call fit() first")
+        Z = (np.asarray(X, np.float32) - self.mean_) / self.scale_
+        return Z @ self.components_.T
+
+    def fit_transform(self, X) -> np.ndarray:
+        return self.fit(X).transform(X)
+
+
+@dataclasses.dataclass
+class PCAOLS:
+    """PCA(n) + OLS pipeline (notebook cell 9)."""
+
+    n_components: int = 15
+    standardize: bool = True
+    add_constant: bool = False
+
+    pca_: Optional[PCA] = None
+    ols_: Optional[OLS] = None
+
+    def fit(self, X, y) -> "PCAOLS":
+        self.pca_ = PCA(self.n_components, standardize=self.standardize).fit(X)
+        self.ols_ = OLS(add_constant=self.add_constant).fit(
+            self.pca_.transform(X), y)
+        return self
+
+    def predict(self, X) -> np.ndarray:
+        if self.ols_ is None:
+            raise RuntimeError("call fit() first")
+        return self.ols_.predict(self.pca_.transform(X))
+
+
+def boosted_regression(X_train, y_train,
+                       param_grid: Optional[dict] = None,
+                       cv: int = 3,
+                       seed: int = 20):
+    """Grid-searched gradient-boosted regressor (notebook cells 10-11).
+
+    Host-side sklearn surrogate for the reference's
+    ``GridSearchCV(XGBRegressor)``; returns the refit best estimator
+    (exposing ``.predict``) plus the chosen parameters and CV RMSE.
+    """
+    from sklearn.ensemble import HistGradientBoostingRegressor
+    from sklearn.model_selection import GridSearchCV
+
+    if param_grid is None:
+        param_grid = {
+            "max_depth": [3, 6],
+            "learning_rate": [0.05],
+            "max_iter": [200, 400],
+        }
+    search = GridSearchCV(
+        HistGradientBoostingRegressor(random_state=seed),
+        param_grid=param_grid,
+        scoring="neg_mean_squared_error",
+        cv=cv,
+    )
+    search.fit(np.asarray(X_train), np.asarray(y_train))
+    best_rmse = float(np.sqrt(-search.best_score_))
+    return search.best_estimator_, search.best_params_, best_rmse

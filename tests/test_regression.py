@@ -1,0 +1,88 @@
+"""Regression-workflow tests (reference ``example/ml.ipynb`` parity).
+
+OLS/PCA validated against independent numpy/sklearn references; the
+boosted grid search exercises the notebook cells 10-11 contract.
+"""
+
+import numpy as np
+import pytest
+
+from porqua_tpu.models.regression import (
+    OLS,
+    PCA,
+    PCAOLS,
+    boosted_regression,
+    calculate_mape,
+    calculate_rmse,
+)
+
+
+@pytest.fixture(scope="module")
+def panel():
+    """Linear factor panel: y = X beta + noise."""
+    rng = np.random.default_rng(21)
+    n, d = 400, 8
+    X = rng.standard_normal((n, d))
+    beta = rng.standard_normal(d)
+    y = X @ beta + 0.05 * rng.standard_normal(n)
+    return X, y, beta
+
+
+def test_ols_matches_numpy_lstsq(panel):
+    X, y, beta = panel
+    model = OLS().fit(X, y)
+    ref, *_ = np.linalg.lstsq(X, y, rcond=None)
+    np.testing.assert_allclose(model.coef_, ref, atol=1e-4)
+    pred = model.predict(X)
+    assert calculate_rmse(y, pred) < 0.06
+    assert calculate_mape(y, pred) < 100.0
+
+
+def test_ols_with_constant():
+    rng = np.random.default_rng(3)
+    X = rng.standard_normal((200, 2))
+    y = 3.0 + X @ np.array([1.0, -2.0])
+    model = OLS(add_constant=True).fit(X, y)
+    assert model.coef_[0] == pytest.approx(3.0, abs=1e-3)
+    np.testing.assert_allclose(model.predict(X), y, atol=1e-3)
+
+
+def test_pca_matches_sklearn(panel):
+    sk_dec = pytest.importorskip("sklearn.decomposition")
+    sk_pre = pytest.importorskip("sklearn.preprocessing")
+    X, *_ = panel
+    ours = PCA(n_components=4).fit(X)
+    Z = sk_pre.StandardScaler().fit_transform(X)
+    theirs = sk_dec.PCA(n_components=4).fit(Z)
+    np.testing.assert_allclose(
+        ours.explained_variance_ratio_[:4],
+        theirs.explained_variance_ratio_, atol=1e-4)
+    # components match up to sign
+    ot = ours.transform(X)
+    tt = theirs.transform(Z)
+    for j in range(4):
+        c = np.corrcoef(ot[:, j], tt[:, j])[0, 1]
+        assert abs(c) > 0.999
+
+
+def test_pca_ols_pipeline_predicts(panel):
+    X, y, _ = panel
+    # full-rank PCA keeps all signal: with an intercept to absorb the
+    # centering, pipeline ~= plain OLS
+    model = PCAOLS(n_components=8, add_constant=True).fit(X, y)
+    assert calculate_rmse(y, model.predict(X)) < 0.06
+    # truncated PCA still beats the mean-only predictor
+    trunc = PCAOLS(n_components=3).fit(X, y)
+    assert calculate_rmse(y, trunc.predict(X)) < calculate_rmse(y, np.full_like(y, y.mean()))
+
+
+def test_boosted_regression_grid_search(panel):
+    X, y, _ = panel
+    est, params, cv_rmse = boosted_regression(
+        X[:300], y[:300],
+        param_grid={"max_depth": [3], "max_iter": [50, 100]}, cv=2)
+    assert set(params) == {"max_depth", "max_iter"}
+    assert cv_rmse > 0
+    pred = est.predict(X[300:])
+    # learns real structure on held-out data
+    assert calculate_rmse(y[300:], pred) < np.std(y[300:])
