@@ -1,0 +1,63 @@
+"""Shared plumbing for the example scripts.
+
+The reference ships its examples as Jupyter notebooks (`example/*.ipynb`)
+that double as the only documentation; these scripts are their runnable
+equivalents. Each script prints what it computes — run any of them with
+``python examples/<name>.py``.
+
+Set ``PORQUA_PLATFORM=cpu`` to force the XLA CPU backend (useful off-TPU;
+the container's sitecustomize pins ``jax_platforms`` at the config level,
+so the plain JAX_PLATFORMS env var is not enough).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+import pandas as pd
+
+# the package is used in-place, not installed (the reference's notebooks
+# do the same with sys.path.insert(1, '../src'))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REFERENCE_DATA = os.environ.get("PORQUA_DATA", "/root/reference/data/")
+
+
+def init_platform() -> None:
+    import jax
+
+    platform = os.environ.get("PORQUA_PLATFORM")
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    # the examples cross-check f64 parity paths; solver code is
+    # dtype-parametric and defaults to f32 on device
+    jax.config.update("jax_enable_x64", True)
+
+
+def load_msci_or_synthetic():
+    """The 24-country MSCI universe if the data mount exists, else a
+    synthetic factor market of the same shape."""
+    from porqua_tpu.data_loader import load_data_msci
+
+    if os.path.isdir(REFERENCE_DATA):
+        return load_data_msci(path=REFERENCE_DATA)
+    rng = np.random.default_rng(0)
+    dates = pd.bdate_range("1999-01-01", periods=6000)
+    n = 24
+    X = pd.DataFrame(0.01 * rng.standard_normal((len(dates), n)),
+                     index=dates, columns=[f"A{i}" for i in range(n)])
+    w = rng.dirichlet(np.ones(n))
+    y = pd.DataFrame({"bm": X.to_numpy() @ w + 0.001 * rng.standard_normal(len(dates))},
+                     index=dates)
+    return {"return_series": X, "bm_series": y}
+
+
+def quarterly_rebdates(index: pd.Index, start: str = "2015-01-01", k: int = 24):
+    """Quarter-end rebalance dates inside the index (the reference's
+    canonical cadence, ``_quick_and_dirty_interactive_testing.py:75-79``)."""
+    qe = pd.Series(index=index, data=1).resample("QE").last().index
+    dates = [str(index[index <= d][-1].date()) for d in qe
+             if str(start) <= str(d.date()) and (index <= d).any()]
+    return dates[:k]

@@ -45,6 +45,7 @@ from porqua_tpu.selection import Selection
 from porqua_tpu.builders import SelectionItemBuilder, OptimizationItemBuilder
 from porqua_tpu.portfolio import Portfolio, Strategy, floating_weights
 from porqua_tpu.backtest import Backtest, BacktestData, BacktestService
+from porqua_tpu.compare import compare_solvers, available_backends
 
 __all__ = [
     "Constraints",
@@ -76,4 +77,6 @@ __all__ = [
     "Backtest",
     "BacktestData",
     "BacktestService",
+    "compare_solvers",
+    "available_backends",
 ]

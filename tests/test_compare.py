@@ -1,0 +1,111 @@
+"""Solver-comparison harness tests (``example/compare_solver.ipynb`` port).
+
+The harness must (a) run every available backend on the identical
+problem, (b) recompute all quality metrics uniformly from the returned
+vectors, and (c) show the backends agreeing — the notebook's whole
+point.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from porqua_tpu.compare import available_backends, compare_solvers, solution_metrics
+from porqua_tpu.constraints import Constraints
+from porqua_tpu.qp import SolverParams
+from porqua_tpu.qp.canonical import CanonicalQP
+
+
+@pytest.fixture(scope="module")
+def tracking_qp():
+    """Small index-tracking QP: budget + LongOnly box, upper 0.25."""
+    rng = np.random.default_rng(17)
+    T, n = 200, 8
+    X = 0.01 * rng.standard_normal((T, n))
+    w_true = rng.dirichlet(np.ones(n))
+    y = X @ w_true + 0.001 * rng.standard_normal(T)
+    P = 2.0 * X.T @ X
+    q = -2.0 * X.T @ y
+    cons = Constraints(selection=[f"A{i}" for i in range(n)])
+    cons.add_budget()
+    cons.add_box("LongOnly", upper=0.25)
+    return cons.to_canonical(P=P, q=q, constant=float(y @ y))
+
+
+def test_backends_available():
+    names = set(available_backends())
+    assert {"device-admm-f32", "device-admm-f64", "scipy-slsqp"} <= names
+    assert "native-cpp-admm" in names  # g++ is in the image
+
+
+def test_compare_solvers_agreement(tracking_qp):
+    df = compare_solvers(tracking_qp)
+    expected_cols = {"solution_found", "objective_value", "primal_residual",
+                     "dual_residual", "duality_gap", "max_residual_Ab",
+                     "max_residual_Gh", "runtime"}
+    assert expected_cols <= set(df.columns)
+    assert df["solution_found"].all(), df
+    # accuracy: objective values agree across backends
+    objs = df["objective_value"]
+    assert objs.max() - objs.min() < 1e-5, objs
+    # reliability: feasibility everywhere
+    assert (df["primal_residual"] < 1e-5).all(), df["primal_residual"]
+    assert (df["max_residual_Ab"] < 1e-6).all()
+    # dual-side metrics exist where backends return duals
+    for name in ("device-admm-f64", "native-cpp-admm"):
+        assert df.loc[name, "dual_residual"] < 1e-6
+        assert df.loc[name, "duality_gap"] < 1e-5
+    # scipy returns no duals -> NaN, not an error
+    assert np.isnan(df.loc["scipy-slsqp", "dual_residual"])
+
+
+def test_compare_solvers_subset_and_unknown(tracking_qp):
+    df = compare_solvers(tracking_qp, solvers=["device-admm-f32"])
+    assert list(df.index) == ["device-admm-f32"]
+    with pytest.raises(KeyError):
+        compare_solvers(tracking_qp, solvers=["osqp-gpu"])
+
+
+def test_solution_metrics_flags_violations(tracking_qp):
+    from porqua_tpu.compare import _numpy_parts
+
+    parts = _numpy_parts(tracking_qp)
+    n = len(parts["q"])
+    # deliberately infeasible point: violates budget and box
+    x_bad = np.full(n, 2.0 / n)
+    m = solution_metrics(parts, x_bad)
+    assert m["primal_residual"] > 0.5  # budget off by 1.0
+    assert m["max_residual_Ab"] > 0.5
+    # feasible uniform point: only metrics near zero on constraints
+    x_ok = np.full(n, 1.0 / n)
+    m2 = solution_metrics(parts, x_ok)
+    assert m2["primal_residual"] < 1e-12
+
+
+@pytest.mark.skipif(not os.path.isdir("/root/reference/data/"),
+                    reason="reference data mount not present")
+def test_compare_on_msci_universe():
+    """The notebook's cell-6 configuration on the real 24-asset universe."""
+    import jax.numpy as jnp
+
+    from porqua_tpu.data_loader import load_data_msci
+    from porqua_tpu.optimization import LeastSquares
+    from porqua_tpu.optimization_data import OptimizationData
+
+    data = load_data_msci(path="/root/reference/data/")
+    X = data["return_series"].tail(500)
+    y = data["bm_series"].reindex(X.index).iloc[:, 0]
+    universe = list(X.columns)
+
+    opt = LeastSquares(dtype=jnp.float64)
+    opt.constraints = Constraints(selection=universe)
+    opt.constraints.add_budget()
+    opt.constraints.add_box("LongOnly", upper=0.1)
+    opt.set_objective(OptimizationData(align=False, return_series=X, bm_series=y))
+    qp = opt.model_canonical()
+
+    df = compare_solvers(qp)
+    assert df["solution_found"].all()
+    objs = df["objective_value"]
+    assert objs.max() - objs.min() < 1e-6 * max(1.0, abs(objs.mean()))
