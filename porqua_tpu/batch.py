@@ -165,6 +165,23 @@ def to_strategy(problems: BatchProblems, solution: QPSolution) -> Strategy:
     return strategy
 
 
+def assemble_backtest(problems: BatchProblems,
+                      solution: QPSolution) -> Backtest:
+    """Wrap a batched device solution in the serial engine's output type
+    (``Backtest`` + ``Strategy``), with the per-date device counters in
+    ``output['batch']``."""
+    backtest = Backtest()
+    backtest._strategy = to_strategy(problems, solution)
+    backtest.output["batch"] = {
+        "status": np.asarray(solution.status),
+        "iters": np.asarray(solution.iters),
+        "prim_res": np.asarray(solution.prim_res),
+        "dual_res": np.asarray(solution.dual_res),
+        "obj_val": np.asarray(solution.obj_val),
+    }
+    return backtest
+
+
 def run_batch(bs: BacktestService,
               params: Optional[SolverParams] = None,
               dtype=jnp.float32) -> Backtest:
@@ -177,13 +194,4 @@ def run_batch(bs: BacktestService,
     params = SolverParams() if params is None else params
     problems = build_problems(bs, dtype=dtype)
     solution = solve_batch(problems, params)
-    backtest = Backtest()
-    backtest._strategy = to_strategy(problems, solution)
-    backtest.output["batch"] = {
-        "status": np.asarray(solution.status),
-        "iters": np.asarray(solution.iters),
-        "prim_res": np.asarray(solution.prim_res),
-        "dual_res": np.asarray(solution.dual_res),
-        "obj_val": np.asarray(solution.obj_val),
-    }
-    return backtest
+    return assemble_backtest(problems, solution)

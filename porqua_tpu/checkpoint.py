@@ -1,0 +1,184 @@
+"""Checkpoint / resume for batched backtests.
+
+The reference's only persistence is whole-object pickle
+(``Backtest.save``, reference ``src/backtest.py:226-237``;
+``QuadraticProgram.serialize``, ``qp_problems.py:223-230`` — whose
+``load`` is buggy) with no notion of resuming a partially-run backtest.
+Here the whole backtest is a device program over a stacked problem
+batch, so checkpointing is array serialization (compressed ``.npz`` —
+portable, no code objects, safe to load) plus a tiny JSON-able manifest,
+and *resume* means: skip already-solved date chunks and warm-start the
+next chunk from the last solved primal/dual point (the on-device analog
+of the reference's ``initvals``/``x0`` warm start,
+``qp_problems.py:213``).
+
+Layout on disk (one directory per run):
+
+    manifest.json     — shapes, rebdates, chunk size, solver params hash
+    chunk_0000.npz    — QPSolution arrays for dates [0, chunk)
+    chunk_0001.npz    — ... and so on
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from porqua_tpu.qp.solve import QPSolution, SolverParams
+
+_SOLUTION_FIELDS = list(QPSolution._fields)
+
+
+def save_solution(path: str, sol: QPSolution) -> None:
+    """Serialize a (possibly batched) QPSolution to compressed npz."""
+    arrays = {f: np.asarray(getattr(sol, f)) for f in _SOLUTION_FIELDS}
+    np.savez_compressed(path, **arrays)
+
+
+def load_solution(path: str) -> QPSolution:
+    with np.load(path) as data:
+        return QPSolution(**{f: jnp.asarray(data[f]) for f in _SOLUTION_FIELDS})
+
+
+def _concat_solutions(sols: List[QPSolution]) -> QPSolution:
+    return QPSolution(*[
+        jnp.concatenate([jnp.atleast_1d(getattr(s, f)) for s in sols], axis=0)
+        for f in _SOLUTION_FIELDS
+    ])
+
+
+@dataclasses.dataclass
+class CheckpointManager:
+    """Chunk-granular checkpoint store for one backtest run.
+
+    ``params_key`` guards against resuming with different solver
+    settings (a changed tolerance silently mixing old and new chunks).
+    """
+
+    directory: str
+    rebdates: List[str]
+    chunk_size: int
+    params_key: str
+
+    @staticmethod
+    def _key(params: SolverParams) -> str:
+        return json.dumps(dataclasses.asdict(params), sort_keys=True)
+
+    @classmethod
+    def create(cls, directory: str, rebdates: List[str], chunk_size: int,
+               params: SolverParams) -> "CheckpointManager":
+        os.makedirs(directory, exist_ok=True)
+        mgr = cls(directory, list(rebdates), int(chunk_size), cls._key(params))
+        manifest_path = os.path.join(directory, "manifest.json")
+        manifest = {
+            "rebdates": mgr.rebdates,
+            "chunk_size": mgr.chunk_size,
+            "params_key": mgr.params_key,
+        }
+        if os.path.exists(manifest_path):
+            with open(manifest_path) as f:
+                existing = json.load(f)
+            if existing != manifest:
+                raise ValueError(
+                    f"checkpoint directory {directory} holds a different run "
+                    "(rebdates/chunk_size/solver params mismatch); use a "
+                    "fresh directory or delete the old checkpoints"
+                )
+        else:
+            with open(manifest_path, "w") as f:
+                json.dump(manifest, f)
+        return mgr
+
+    @property
+    def n_chunks(self) -> int:
+        return (len(self.rebdates) + self.chunk_size - 1) // self.chunk_size
+
+    def chunk_path(self, idx: int) -> str:
+        return os.path.join(self.directory, f"chunk_{idx:04d}.npz")
+
+    def completed_chunks(self) -> int:
+        """Number of leading chunks already on disk (gap == stop)."""
+        done = 0
+        while done < self.n_chunks and os.path.exists(self.chunk_path(done)):
+            done += 1
+        return done
+
+    def save_chunk(self, idx: int, sol: QPSolution) -> None:
+        # Write-then-rename so a crash mid-write never yields a torn
+        # chunk that a resume would trust.
+        tmp = self.chunk_path(idx) + ".tmp.npz"
+        save_solution(tmp, sol)
+        os.replace(tmp, self.chunk_path(idx))
+
+    def load_all(self, upto: Optional[int] = None) -> Optional[QPSolution]:
+        upto = self.completed_chunks() if upto is None else upto
+        if upto == 0:
+            return None
+        return _concat_solutions(
+            [load_solution(self.chunk_path(i)) for i in range(upto)]
+        )
+
+
+def run_batch_checkpointed(bs,
+                           directory: str,
+                           chunk_size: int = 64,
+                           params: Optional[SolverParams] = None,
+                           dtype=jnp.float32):
+    """``run_batch`` with chunk-granular checkpoint/resume.
+
+    Splits the date batch into ``chunk_size`` sub-batches, solves them
+    in order, persists each, and on a rerun resumes after the last
+    complete chunk — warm-starting the first new chunk's problems from
+    the final solved date's primal/dual point. Returns the same
+    ``Backtest`` object as :func:`porqua_tpu.batch.run_batch`.
+    """
+    import jax
+
+    from porqua_tpu.batch import assemble_backtest, build_problems
+    from porqua_tpu.qp.solve import solve_qp_batch
+
+    params = SolverParams() if params is None else params
+    problems = build_problems(bs, dtype=dtype)
+    mgr = CheckpointManager.create(
+        directory, problems.rebdates, chunk_size, params
+    )
+
+    start = mgr.completed_chunks()
+    sols: List[QPSolution] = []
+    if start:
+        sols.append(mgr.load_all(start))
+
+    warm_x = warm_y = None
+    if sols:
+        warm_x = sols[-1].x[-1]
+        warm_y = sols[-1].y[-1]
+
+    for idx in range(start, mgr.n_chunks):
+        lo = idx * chunk_size
+        hi = min(lo + chunk_size, len(problems.rebdates))
+        qp_chunk = jax.tree.map(lambda a: a[lo:hi], problems.qp)
+        bsz = hi - lo
+        x0 = None if warm_x is None else jnp.broadcast_to(
+            warm_x, (bsz,) + warm_x.shape
+        )
+        y0 = None if warm_y is None else jnp.broadcast_to(
+            warm_y, (bsz,) + warm_y.shape
+        )
+        sol = solve_qp_batch(qp_chunk, params, x0, y0)
+        mgr.save_chunk(idx, sol)
+        sols.append(sol)
+        warm_x, warm_y = sol.x[-1], sol.y[-1]
+
+    solution = _concat_solutions(sols) if len(sols) > 1 else sols[0]
+    backtest = assemble_backtest(problems, solution)
+    backtest.output["checkpoint"] = {
+        "directory": directory,
+        "resumed_chunks": start,
+        "total_chunks": mgr.n_chunks,
+    }
+    return backtest

@@ -1,0 +1,5 @@
+"""Pallas TPU kernels for the solver hot loop."""
+
+from porqua_tpu.ops.admm_kernel import admm_segment
+
+__all__ = ["admm_segment"]

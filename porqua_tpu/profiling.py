@@ -1,0 +1,159 @@
+"""Per-stage tracing / profiling instrumentation.
+
+The reference has no built-in profiling — just ad-hoc ``time.time()``
+deltas in a test tearDown (reference ``test/tests_quadratic_program.py:
+67-71``) and in ``example/compare_solver.ipynb`` cells 6/12, plus solver
+runtime pickled by ``serialize_solution`` (``helper_functions.py:
+69-80``). This module is the structured replacement: stage timers that
+understand the XLA execution model (trace/lower/compile vs execute are
+different costs; the first call pays compilation), on-device counters
+reported by the solver itself (iterations, residuals — no host
+round-trips during the solve), and an optional bridge to the JAX
+profiler for TensorBoard traces.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import numpy as np
+
+
+@dataclasses.dataclass
+class StageTiming:
+    name: str
+    seconds: float
+    meta: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+class Tracer:
+    """Collects named stage timings; nestable via context manager.
+
+    Usage::
+
+        tracer = Tracer()
+        with tracer.stage("build"):
+            problems = build_problems(bs)          # host work: no holder
+        with tracer.stage("solve") as holder:
+            holder["value"] = solve_batch(problems, params)  # device work
+        tracer.report()
+
+    Device stages MUST put their output in the yielded holder — JAX
+    dispatch is asynchronous, so a stage that merely *calls* a jitted
+    function records dispatch time (~1 ms) while the device seconds get
+    misattributed to whatever blocks next. The holder value is
+    ``jax.block_until_ready``-ed before the clock stops.
+    """
+
+    def __init__(self) -> None:
+        self.timings: List[StageTiming] = []
+
+    @contextlib.contextmanager
+    def stage(self, name: str, block: bool = True, **meta):
+        """Time a stage. Yields a dict; store the stage's device output
+        under ``"value"`` and (with ``block=True``) it is blocked on
+        before the clock stops — see the class docstring for why pure
+        host stages can skip the holder but device stages must not."""
+        t0 = time.perf_counter()
+        result_holder: Dict[str, Any] = {}
+        try:
+            yield result_holder
+        finally:
+            if block and "value" in result_holder:
+                jax.block_until_ready(result_holder["value"])
+            self.timings.append(
+                StageTiming(name, time.perf_counter() - t0, dict(meta))
+            )
+
+    def total(self) -> float:
+        return sum(t.seconds for t in self.timings)
+
+    def as_dict(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for t in self.timings:
+            out[t.name] = out.get(t.name, 0.0) + t.seconds
+        return out
+
+    def report(self, file=None) -> str:
+        lines = [f"{t.name:<24s} {t.seconds * 1e3:10.1f} ms  {t.meta or ''}"
+                 for t in self.timings]
+        lines.append(f"{'total':<24s} {self.total() * 1e3:10.1f} ms")
+        text = "\n".join(lines)
+        if file is not None:
+            print(text, file=file)
+        return text
+
+    def to_json(self) -> str:
+        return json.dumps(
+            [dataclasses.asdict(t) for t in self.timings], default=str
+        )
+
+
+def timed_stages(fn: Callable, *args,
+                 lower_kwargs: Optional[dict] = None) -> Dict[str, float]:
+    """Split a jitted call into trace/lower, compile, and execute time.
+
+    Mirrors what the driver cares about: first-call latency is dominated
+    by XLA compilation (~20-40s on TPU for the full backtest program),
+    steady-state latency by execution. Returns seconds per stage.
+    """
+    lower_kwargs = lower_kwargs or {}
+    t0 = time.perf_counter()
+    lowered = jax.jit(fn).lower(*args, **lower_kwargs)
+    t1 = time.perf_counter()
+    compiled = lowered.compile()
+    t2 = time.perf_counter()
+    out = compiled(*args, **lower_kwargs)
+    jax.block_until_ready(out)
+    t3 = time.perf_counter()
+    out = compiled(*args, **lower_kwargs)
+    jax.block_until_ready(out)
+    t4 = time.perf_counter()
+    return {
+        "trace_lower": t1 - t0,
+        "compile": t2 - t1,
+        "execute_first": t3 - t2,
+        "execute": t4 - t3,
+    }
+
+
+def solve_stats(solution) -> Dict[str, Any]:
+    """Summarize the on-device counters a batched solve reports.
+
+    The per-problem iteration counts / residuals / status codes are
+    device arrays produced *inside* the jitted program (SURVEY.md §5:
+    "solve-iteration counts reported from the device") — this is the
+    host-side rollup for logs and dashboards.
+    """
+    from porqua_tpu.qp.admm import Status
+
+    status = np.asarray(solution.status)
+    iters = np.asarray(solution.iters)
+    return {
+        "n_problems": int(status.size),
+        "solved": int((status == Status.SOLVED).sum()),
+        "max_iter": int((status == Status.MAX_ITER).sum()),
+        "primal_infeasible": int((status == Status.PRIMAL_INFEASIBLE).sum()),
+        "dual_infeasible": int((status == Status.DUAL_INFEASIBLE).sum()),
+        "iters_mean": float(iters.mean()) if iters.size else 0.0,
+        "iters_max": int(iters.max()) if iters.size else 0,
+        "prim_res_max": float(np.asarray(solution.prim_res).max()),
+        "dual_res_max": float(np.asarray(solution.dual_res).max()),
+    }
+
+
+@contextlib.contextmanager
+def device_trace(logdir: str):
+    """Bridge to the JAX profiler: captures an XLA device trace viewable
+    in TensorBoard / Perfetto. Wrap the steady-state call, not the
+    compiling one."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

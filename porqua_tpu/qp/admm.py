@@ -73,6 +73,7 @@ class SolverParams:
 
     max_iter: int = 4000
     check_interval: int = 25
+    backend: str = "auto"  # "auto" | "xla" | "pallas"
     eps_abs: float = 1e-6
     eps_rel: float = 1e-6
     eps_pinf: float = 1e-5
@@ -246,6 +247,22 @@ def admm_solve(qp: CanonicalQP,
         mu_new = mu + rho_b * (alpha * xt + (1 - alpha) * w - w_new)
         return (x_new, z_new, w_new, y_new, mu_new)
 
+    use_pallas = params.backend == "pallas" or (
+        params.backend == "auto" and jax.default_backend() == "tpu"
+    )
+    # The Pallas segment applies the KKT matrix through an explicit
+    # inverse, which loses accuracy quadratically with cond(K); K
+    # carries rho_eq_scale * rho on equality rows, so the adaptive-rho
+    # clamp must stay inside what an f32 inverse can represent.
+    # [1e-3, 1e2] keeps cond(K) within f32 range on Ruiz-equilibrated
+    # problems (OSQP's wider f64 clamp makes the inverse diverge on
+    # TPU); the triangular-solve XLA path keeps the caller's clamp.
+    if use_pallas:
+        rho_lo = max(params.rho_min, 1e-3)
+        rho_hi = min(params.rho_max, 1e2)
+    else:
+        rho_lo, rho_hi = params.rho_min, params.rho_max
+
     def segment(state: ADMMState) -> ADMMState:
         rho, rho_b = _rho_vectors(qp, state.rho_bar, params)
         K = (
@@ -256,17 +273,35 @@ def admm_solve(qp: CanonicalQP,
         )
         chol = cho_factor(K)
 
-        def body(_, carry):
-            return one_iteration(carry, chol, rho, rho_b)
+        if use_pallas:
+            # Fused segment with the explicit KKT inverse VMEM-resident:
+            # the extra n^3 for the inverse amortizes over check_interval
+            # iterations that would otherwise each re-read the factor
+            # from HBM (see porqua_tpu.ops.admm_kernel).
+            from porqua_tpu.ops.admm_kernel import admm_segment
 
-        carry0 = (state.x, state.z, state.w, state.y, state.mu)
-        # Run check_interval - 1 iterations, then one more recording deltas
-        carry = jax.lax.fori_loop(0, params.check_interval - 1, body, carry0)
-        carry_next = one_iteration(carry, chol, rho, rho_b)
-        x, z, w, y, mu = carry_next
-        dx = x - carry[0]
-        dy = y - carry[3]
-        dmu = mu - carry[4]
+            Kinv = cho_solve(chol, jnp.eye(n, dtype=dtype))
+            x, z, w, y, mu, dx, dy, dmu = admm_segment(
+                Kinv, qp.C, qp.q, qp.l, qp.u, qp.lb, qp.ub, rho, rho_b,
+                state.x, state.z, state.w, state.y, state.mu,
+                sigma=params.sigma, alpha=params.alpha,
+                n_iters=params.check_interval,
+                interpret=jax.default_backend() != "tpu",
+            )
+        else:
+            def body(_, carry):
+                return one_iteration(carry, chol, rho, rho_b)
+
+            carry0 = (state.x, state.z, state.w, state.y, state.mu)
+            # Run check_interval - 1 iterations, then one more recording deltas
+            carry = jax.lax.fori_loop(
+                0, params.check_interval - 1, body, carry0
+            )
+            carry_next = one_iteration(carry, chol, rho, rho_b)
+            x, z, w, y, mu = carry_next
+            dx = x - carry[0]
+            dy = y - carry[3]
+            dmu = mu - carry[4]
 
         r_prim, r_dual, eps_p, eps_d, denom_p, denom_d = _residuals(
             qp, scaling, x, z, w, y, mu, params
@@ -289,7 +324,7 @@ def admm_solve(qp: CanonicalQP,
                 (r_prim / jnp.maximum(denom_p, 1e-12))
                 / jnp.maximum(r_dual / jnp.maximum(denom_d, 1e-12), 1e-12)
             )
-            rho_new = jnp.clip(state.rho_bar * ratio, params.rho_min, params.rho_max)
+            rho_new = jnp.clip(state.rho_bar * ratio, rho_lo, rho_hi)
         else:
             rho_new = state.rho_bar
 

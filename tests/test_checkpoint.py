@@ -1,0 +1,144 @@
+"""Checkpoint/resume tests: chunked backtest persistence and warm-start
+resume (SURVEY.md §5 "Checkpoint / resume" — the capability the
+reference's pickle-only persistence lacks)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from porqua_tpu.checkpoint import (
+    CheckpointManager,
+    load_solution,
+    run_batch_checkpointed,
+    save_solution,
+)
+from porqua_tpu.qp.canonical import CanonicalQP, stack_qps
+from porqua_tpu.qp.solve import SolverParams, solve_qp_batch
+
+
+def _random_batch(rng, n_problems=6, n=10, m=3):
+    qps = []
+    for _ in range(n_problems):
+        A = rng.standard_normal((n, n))
+        P = A @ A.T + 0.5 * np.eye(n)
+        q = rng.standard_normal(n)
+        C = np.vstack([np.ones(n), rng.standard_normal((m - 1, n))])
+        l = np.concatenate([[1.0], np.full(m - 1, -2.0)])
+        u = np.concatenate([[1.0], np.full(m - 1, 2.0)])
+        qps.append(CanonicalQP.build(P, q, C, l, u,
+                                     np.full(n, -3.0), np.full(n, 3.0),
+                                     dtype=np.float64))
+    return stack_qps(qps)
+
+
+class TestSolutionSerialization:
+    def test_roundtrip(self, rng, tmp_path):
+        qp = _random_batch(rng)
+        sol = solve_qp_batch(qp, SolverParams())
+        path = str(tmp_path / "sol.npz")
+        save_solution(path, sol)
+        loaded = load_solution(path)
+        for f in sol._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(sol, f)), np.asarray(getattr(loaded, f))
+            )
+
+
+class TestCheckpointManager:
+    def test_chunk_accounting(self, rng, tmp_path):
+        qp = _random_batch(rng, n_problems=5)
+        sol = solve_qp_batch(qp, SolverParams())
+        params = SolverParams()
+        mgr = CheckpointManager.create(
+            str(tmp_path / "run"), [f"d{i}" for i in range(5)], 2, params
+        )
+        assert mgr.n_chunks == 3
+        assert mgr.completed_chunks() == 0
+        one = jax.tree.map(lambda a: a[:2], sol)
+        mgr.save_chunk(0, one)
+        assert mgr.completed_chunks() == 1
+        # A gap must stop the resume scan.
+        mgr.save_chunk(2, jax.tree.map(lambda a: a[4:5], sol))
+        assert mgr.completed_chunks() == 1
+
+    def test_param_mismatch_rejected(self, tmp_path):
+        d = str(tmp_path / "run")
+        CheckpointManager.create(d, ["a", "b"], 1, SolverParams())
+        with pytest.raises(ValueError, match="different run"):
+            CheckpointManager.create(d, ["a", "b"], 1,
+                                     SolverParams(eps_abs=1e-3))
+
+
+class TestRunBatchCheckpointed:
+    def _make_service(self):
+        import pandas as pd
+
+        from porqua_tpu.backtest import BacktestService
+        from porqua_tpu.builders import (
+            OptimizationItemBuilder,
+            SelectionItemBuilder,
+            bibfn_box_constraints,
+            bibfn_budget_constraint,
+            bibfn_return_series,
+            bibfn_selection_data,
+        )
+        from porqua_tpu.optimization import QEQW
+
+        rng = np.random.default_rng(7)
+        n_assets, n_days = 6, 400
+        dates = pd.bdate_range("2020-01-01", periods=n_days)
+        X = pd.DataFrame(
+            rng.standard_normal((n_days, n_assets)) * 0.01,
+            index=dates,
+            columns=[f"A{i}" for i in range(n_assets)],
+        )
+        data = {"return_series": X}
+        rebdates = [str(d.date()) for d in dates[150::50][:5]]
+        return BacktestService(
+            data=data,
+            selection_item_builders={
+                "data": SelectionItemBuilder(bibfn=bibfn_selection_data),
+            },
+            optimization_item_builders={
+                "returns": OptimizationItemBuilder(
+                    bibfn=bibfn_return_series, width=100),
+                "budget": OptimizationItemBuilder(
+                    bibfn=bibfn_budget_constraint, budget=1),
+                "box": OptimizationItemBuilder(
+                    bibfn=bibfn_box_constraints, upper=0.5),
+            },
+            optimization=QEQW(),
+            settings={"rebdates": rebdates, "quiet": True},
+        )
+
+    def test_resume_matches_fresh(self, tmp_path):
+        """A run interrupted after chunk 0 must finish to the same
+        weights as an uninterrupted run."""
+        params = SolverParams(max_iter=2000)
+
+        bs = self._make_service()
+        fresh = run_batch_checkpointed(
+            bs, str(tmp_path / "fresh"), chunk_size=2, params=params
+        )
+        assert fresh.output["checkpoint"]["resumed_chunks"] == 0
+
+        # Simulate an interrupted run: only chunk 0 present.
+        import os
+        import shutil
+        resume_dir = str(tmp_path / "resume")
+        os.makedirs(resume_dir)
+        shutil.copy(os.path.join(str(tmp_path / "fresh"), "manifest.json"),
+                    os.path.join(resume_dir, "manifest.json"))
+        shutil.copy(os.path.join(str(tmp_path / "fresh"), "chunk_0000.npz"),
+                    os.path.join(resume_dir, "chunk_0000.npz"))
+
+        bs2 = self._make_service()
+        resumed = run_batch_checkpointed(
+            bs2, resume_dir, chunk_size=2, params=params
+        )
+        assert resumed.output["checkpoint"]["resumed_chunks"] == 1
+
+        wf = fresh.strategy.get_weights_df()
+        wr = resumed.strategy.get_weights_df()
+        np.testing.assert_allclose(wf.values, wr.values, atol=1e-6)

@@ -1,0 +1,62 @@
+"""Tests for the tracing/profiling subsystem (SURVEY.md §5)."""
+
+import io
+
+import jax.numpy as jnp
+import numpy as np
+
+from porqua_tpu.profiling import Tracer, solve_stats, timed_stages
+from porqua_tpu.qp.canonical import CanonicalQP
+from porqua_tpu.qp.solve import SolverParams, solve_qp
+
+
+def _small_qp(rng):
+    n = 8
+    A = rng.standard_normal((n, n))
+    P = A @ A.T + 0.5 * np.eye(n)
+    q = rng.standard_normal(n)
+    C = np.ones((1, n))
+    return CanonicalQP.build(P, q, C, np.array([1.0]), np.array([1.0]),
+                             np.zeros(n), np.ones(n), dtype=np.float64)
+
+
+class TestTracer:
+    def test_stages_collected(self):
+        tracer = Tracer()
+        with tracer.stage("build", n=3):
+            x = jnp.arange(10.0)
+        with tracer.stage("solve") as holder:
+            holder["value"] = x * 2
+        assert [t.name for t in tracer.timings] == ["build", "solve"]
+        assert tracer.total() > 0
+        assert tracer.as_dict()["build"] >= 0
+        report = tracer.report(file=io.StringIO())
+        assert "total" in report
+        assert "{'n': 3}" in report
+
+    def test_repeat_stage_aggregates(self):
+        tracer = Tracer()
+        for _ in range(3):
+            with tracer.stage("solve"):
+                pass
+        assert len(tracer.timings) == 3
+        assert len(tracer.as_dict()) == 1
+
+
+class TestTimedStages:
+    def test_compile_vs_execute_split(self, rng):
+        stats = timed_stages(lambda x: (x @ x).sum(),
+                             jnp.eye(16, dtype=jnp.float64))
+        assert set(stats) == {"trace_lower", "compile",
+                              "execute_first", "execute"}
+        assert all(v >= 0 for v in stats.values())
+
+
+class TestSolveStats:
+    def test_rollup(self, rng):
+        sol = solve_qp(_small_qp(rng), SolverParams())
+        stats = solve_stats(sol)
+        assert stats["n_problems"] == 1
+        assert stats["solved"] == 1
+        assert stats["iters_max"] >= 1
+        assert stats["prim_res_max"] < 1e-4
