@@ -50,6 +50,8 @@ class BatchProblems:
     n_assets_max: int               # weights live in x[:n_assets_max]
     turnover_rows: Optional[slice] = None   # rows of C holding the x0 bounds
     constants: Optional[np.ndarray] = None
+    l1_weight: Optional[jax.Array] = None   # (dates, n) native L1 term weights
+    l1_center: Optional[jax.Array] = None   # (dates, n) native L1 term centers
 
     @property
     def n_dates(self) -> int:
@@ -87,19 +89,37 @@ def build_problems(bs: BacktestService,
         )
         for p in parts_list
     ]
+    l1_weight = l1_center = None
+    if any("l1_weight" in p for p in parts_list):
+        def pad_n(v):
+            return np.pad(np.asarray(v, float), (0, n_max - len(v)))
+
+        l1_weight = jnp.asarray(np.stack([
+            pad_n(p["l1_weight"]) if "l1_weight" in p else np.zeros(n_max)
+            for p in parts_list
+        ]), dtype=dtype)
+        l1_center = jnp.asarray(np.stack([
+            pad_n(p["l1_center"]) if "l1_center" in p else np.zeros(n_max)
+            for p in parts_list
+        ]), dtype=dtype)
+
     return BatchProblems(
         qp=stack_qps(qps),
         rebdates=rebdates,
         universes=universes,
         n_assets_max=n_assets_max,
         constants=np.array([p.get("constant", 0.0) for p in parts_list]),
+        l1_weight=l1_weight,
+        l1_center=l1_center,
     )
 
 
 def solve_batch(problems: BatchProblems,
                 params: SolverParams = SolverParams()) -> QPSolution:
     """Pass 2, independent dates: one vmapped device solve."""
-    return solve_qp_batch(problems.qp, params)
+    return solve_qp_batch(problems.qp, params,
+                          l1_weight=problems.l1_weight,
+                          l1_center=problems.l1_center)
 
 
 def solve_scan_turnover(qp: CanonicalQP,

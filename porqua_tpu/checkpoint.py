@@ -169,7 +169,9 @@ def run_batch_checkpointed(bs,
         y0 = None if warm_y is None else jnp.broadcast_to(
             warm_y, (bsz,) + warm_y.shape
         )
-        sol = solve_qp_batch(qp_chunk, params, x0, y0)
+        l1w = None if problems.l1_weight is None else problems.l1_weight[lo:hi]
+        l1c = None if problems.l1_center is None else problems.l1_center[lo:hi]
+        sol = solve_qp_batch(qp_chunk, params, x0, y0, l1w, l1c)
         mgr.save_chunk(idx, sol)
         sols.append(sol)
         warm_x, warm_y = sol.x[-1], sol.y[-1]

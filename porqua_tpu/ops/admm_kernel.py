@@ -39,7 +39,8 @@ def _round_up(x: int, mult: int) -> int:
 
 
 def _segment_kernel(Kinv_ref, C_ref, q_ref, l_ref, u_ref, lb_ref, ub_ref,
-                    rho_ref, rhob_ref, x_ref, z_ref, w_ref, y_ref, mu_ref,
+                    rho_ref, rhob_ref, l1w_ref, l1c_ref,
+                    x_ref, z_ref, w_ref, y_ref, mu_ref,
                     x_out, z_out, w_out, y_out, mu_out,
                     dx_out, dy_out, dmu_out,
                     *, sigma: float, alpha: float, n_iters: int):
@@ -54,6 +55,8 @@ def _segment_kernel(Kinv_ref, C_ref, q_ref, l_ref, u_ref, lb_ref, ub_ref,
     ub = ub_ref[:]
     rho = rho_ref[:]
     rho_b = rhob_ref[:]
+    l1w = l1w_ref[:]
+    l1c = l1c_ref[:]
     inv_rho = 1.0 / rho
     inv_rhob = 1.0 / rho_b
     sig = jnp.asarray(sigma, dtype)
@@ -86,7 +89,11 @@ def _segment_kernel(Kinv_ref, C_ref, q_ref, l_ref, u_ref, lb_ref, ub_ref,
         z_new = jnp.clip(z_pre + y * inv_rho, l, u)
         y_new = y + rho * (z_pre - z_new)
         w_pre = al * xt + one_m_al * w
-        w_new = jnp.clip(w_pre + mu * inv_rhob, lb, ub)
+        # Clipped shifted soft-threshold (identical to admm.one_iteration):
+        # exact prox of box + l1w*|.-l1c|; plain clip when l1w == 0.
+        s = w_pre + mu * inv_rhob - l1c
+        soft = jnp.sign(s) * jnp.maximum(jnp.abs(s) - l1w * inv_rhob, 0.0)
+        w_new = jnp.clip(l1c + soft, lb, ub)
         mu_new = mu + rho_b * (w_pre - w_new)
         return (x_new, z_new, w_new, y_new, mu_new)
 
@@ -119,6 +126,8 @@ def admm_segment(Kinv: jax.Array,
                  ub: jax.Array,
                  rho: jax.Array,
                  rho_b: jax.Array,
+                 l1w: jax.Array,
+                 l1c: jax.Array,
                  x: jax.Array,
                  z: jax.Array,
                  w: jax.Array,
@@ -166,6 +175,7 @@ def admm_segment(Kinv: jax.Array,
         pad_vec(l, m_p, -inf), pad_vec(u, m_p, inf),
         pad_vec(lb, n_p), pad_vec(ub, n_p),
         pad_vec(rho, m_p, 1.0), pad_vec(rho_b, n_p, 1.0),
+        pad_vec(l1w, n_p), pad_vec(l1c, n_p),
         pad_vec(x, n_p), pad_vec(z, m_p), pad_vec(w, n_p),
         pad_vec(y, m_p), pad_vec(mu, n_p),
     )

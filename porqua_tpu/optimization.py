@@ -96,7 +96,13 @@ class Optimization(ABC):
         if x0 is not None and x0.shape[0] != qp.n:
             x0 = np.concatenate([x0, np.zeros(qp.n - x0.shape[0])])
 
-        sol = solve_qp(qp, solver_params, x0=None if x0 is None else np.asarray(x0, dtype=np.asarray(qp.q).dtype))
+        l1 = getattr(self, "_l1_pair", None)
+        sol = solve_qp(
+            qp, solver_params,
+            x0=None if x0 is None else np.asarray(x0, dtype=np.asarray(qp.q).dtype),
+            l1_weight=None if l1 is None else l1[0],
+            l1_center=None if l1 is None else l1[1],
+        )
         self.solution = sol
 
         universe = self.constraints.selection
@@ -163,7 +169,16 @@ class Optimization(ABC):
         transaction_cost = self.params.get("transaction_cost")
         tocon = self.constraints.l1.get("turnover")
         if transaction_cost and x_init is not None:
-            parts = lift.lift_turnover_objective(parts, x_init, transaction_cost)
+            if self.params.get("l1_native"):
+                # Native prox path: keep the problem at n variables and
+                # hand the turnover-cost term to the solver's w-block
+                # soft-threshold (admm_solve l1_weight/l1_center) — the
+                # static-shape alternative to the reference's 2x
+                # variable expansion (qp_problems.py:120-157).
+                parts["l1_weight"] = np.full(n, float(transaction_cost))
+                parts["l1_center"] = np.asarray(x_init, dtype=float)
+            else:
+                parts = lift.lift_turnover_objective(parts, x_init, transaction_cost)
         elif tocon and x_init is not None:
             parts = lift.lift_turnover_constraint(parts, x_init, tocon["rhs"])
         levcon = self.constraints.l1.get("leverage")
@@ -182,6 +197,15 @@ class Optimization(ABC):
             n_max=self.params.get("n_max"), m_max=self.params.get("m_max"),
             dtype=self.params.get("dtype"),
         )
+        if "l1_weight" in parts:
+            n_pad = self.model.n
+            dt = np.asarray(self.model.q).dtype
+            self._l1_pair = (
+                np.pad(parts["l1_weight"], (0, n_pad - len(parts["l1_weight"]))).astype(dt),
+                np.pad(parts["l1_center"], (0, n_pad - len(parts["l1_center"]))).astype(dt),
+            )
+        else:
+            self._l1_pair = None
         return self.model
 
     def _x_init_array(self) -> Optional[np.ndarray]:

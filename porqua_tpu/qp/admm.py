@@ -153,8 +153,15 @@ def _residuals(qp: CanonicalQP, scaling: Scaling, x, z, w, y, mu, params: Solver
     return r_prim, r_dual, eps_prim, eps_dual, denom_p, denom_d
 
 
-def _infeasibility(qp: CanonicalQP, scaling: Scaling, dx, dy, dmu, params: SolverParams):
-    """OSQP certificates from one-iteration increments (unscaled)."""
+def _infeasibility(qp: CanonicalQP, scaling: Scaling, dx, dy, dmu,
+                   params: SolverParams, l1w=None):
+    """OSQP certificates from one-iteration increments (unscaled).
+
+    ``l1w`` (scaled frame) is the native L1 term's per-variable weight:
+    along a recession direction the nonsmooth term grows like
+    ``sum l1w |dx|``, which must be added to the objective slope before
+    declaring dual infeasibility (otherwise a problem bounded only by
+    the L1 penalty is misreported as unbounded)."""
     dtype = dx.dtype
     # Unscaled increments
     dy_u = (1.0 / scaling.c) * scaling.E * dy
@@ -183,6 +190,9 @@ def _infeasibility(qp: CanonicalQP, scaling: Scaling, dx, dy, dmu, params: Solve
     norm_dx = _inf_norm(dx_u)
     Pdx = (1.0 / scaling.c) * (1.0 / scaling.D) * (qp.P @ dx)
     qdx = (1.0 / scaling.c) * jnp.dot(qp.q, dx)
+    if l1w is not None:
+        # Unscaled L1 slope: sum_i w_i |D_i dx_i| = (1/c) sum_i l1w_i |dx_i|.
+        qdx = qdx + (1.0 / scaling.c) * jnp.sum(l1w * jnp.abs(dx))
     Cdx = (1.0 / scaling.E) * (qp.C @ dx)
     tol = params.eps_dinf * norm_dx
     cone_ok = jnp.all(
@@ -205,16 +215,31 @@ def admm_solve(qp: CanonicalQP,
                scaling: Scaling,
                params: SolverParams,
                x0: Optional[jax.Array] = None,
-               y0: Optional[jax.Array] = None) -> ADMMState:
+               y0: Optional[jax.Array] = None,
+               l1_weight: Optional[jax.Array] = None,
+               l1_center: Optional[jax.Array] = None) -> ADMMState:
     """Run the ADMM loop on one *scaled* problem. Returns the final state.
 
     ``x0``/``y0`` warm starts are in the scaled frame (callers go through
     :func:`porqua_tpu.qp.solve.solve_qp`, which handles scaling).
+
+    ``l1_weight``/``l1_center`` (scaled frame, per-variable) add a
+    nonsmooth objective term sum_i l1_weight_i * |x_i - l1_center_i|
+    handled *natively* by the w-block prox — the box projection becomes
+    a clipped shifted soft-threshold (in 1-D,
+    ``prox_{I_[lb,ub] + lam|.-c|} = clip(c + soft(v - c, lam))`` since a
+    convex 1-D objective restricted to an interval attains its minimum
+    at the projection of the unconstrained minimizer). This is the
+    static-shape TPU alternative to the reference's dimension-expanding
+    turnover-cost linearization (reference ``qp_problems.py:120-157``,
+    mirrored by :func:`porqua_tpu.qp.lift.lift_turnover_objective`).
     """
     dtype = qp.P.dtype
     n, m = qp.n, qp.m
     sigma = jnp.asarray(params.sigma, dtype)
     alpha = jnp.asarray(params.alpha, dtype)
+    l1w = jnp.zeros(n, dtype) if l1_weight is None else l1_weight
+    l1c = jnp.zeros(n, dtype) if l1_center is None else l1_center
 
     x_init = jnp.zeros(n, dtype) if x0 is None else x0
     y_init = jnp.zeros(m, dtype) if y0 is None else y0
@@ -243,7 +268,11 @@ def admm_solve(qp: CanonicalQP,
         y_new = y + rho * (alpha * zt + (1 - alpha) * z - z_new)
 
         w_arg = alpha * xt + (1 - alpha) * w + mu / rho_b
-        w_new = jnp.clip(w_arg, qp.lb, qp.ub)
+        # Clipped shifted soft-threshold: exact prox of box + L1 term
+        # (reduces to the plain box projection when l1w == 0).
+        s = w_arg - l1c
+        soft = jnp.sign(s) * jnp.maximum(jnp.abs(s) - l1w / rho_b, 0.0)
+        w_new = jnp.clip(l1c + soft, qp.lb, qp.ub)
         mu_new = mu + rho_b * (alpha * xt + (1 - alpha) * w - w_new)
         return (x_new, z_new, w_new, y_new, mu_new)
 
@@ -283,6 +312,7 @@ def admm_solve(qp: CanonicalQP,
             Kinv = cho_solve(chol, jnp.eye(n, dtype=dtype))
             x, z, w, y, mu, dx, dy, dmu = admm_segment(
                 Kinv, qp.C, qp.q, qp.l, qp.u, qp.lb, qp.ub, rho, rho_b,
+                l1w, l1c,
                 state.x, state.z, state.w, state.y, state.mu,
                 sigma=params.sigma, alpha=params.alpha,
                 n_iters=params.check_interval,
@@ -307,7 +337,10 @@ def admm_solve(qp: CanonicalQP,
             qp, scaling, x, z, w, y, mu, params
         )
         solved = (r_prim <= eps_p) & (r_dual <= eps_d)
-        p_inf, d_inf, _ = _infeasibility(qp, scaling, dx, dy, dmu, params)
+        p_inf, d_inf, _ = _infeasibility(
+            qp, scaling, dx, dy, dmu, params,
+            l1w=None if l1_weight is None else l1w,
+        )
 
         status = jnp.where(
             solved,

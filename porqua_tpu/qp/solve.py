@@ -54,17 +54,39 @@ class QPSolution(NamedTuple):
 def _solve_impl(qp: CanonicalQP,
                 params: SolverParams,
                 x0: Optional[jax.Array],
-                y0: Optional[jax.Array]) -> QPSolution:
+                y0: Optional[jax.Array],
+                l1_weight: Optional[jax.Array] = None,
+                l1_center: Optional[jax.Array] = None) -> QPSolution:
     scaled, scaling = equilibrate(qp, iters=params.scaling_iters)
 
     x0_s = None if x0 is None else x0 / scaling.D
     y0_s = None if y0 is None else scaling.c * y0 / jnp.where(scaling.E > 0, scaling.E, 1.0)
 
-    state = admm_solve(scaled, scaling, params, x0=x0_s, y0=y0_s)
+    # The L1 term sum_i w_i |x_i - c_i| is stated in the original frame;
+    # with x = D xhat and objective scaling c it becomes
+    # sum_i (c * w_i * D_i) |xhat_i - c_i / D_i| in the scaled frame.
+    l1w_s = None if l1_weight is None else scaling.c * l1_weight * scaling.D
+    l1c_s = None if l1_center is None else l1_center / scaling.D
+
+    state = admm_solve(scaled, scaling, params, x0=x0_s, y0=y0_s,
+                       l1_weight=l1w_s, l1_center=l1c_s)
     x, z, w, y, mu = state.x, state.z, state.w, state.y, state.mu
 
+    # The LU polish solves the smooth-QP KKT system on the active box
+    # set; with a nonsmooth L1 term the stationarity condition carries a
+    # subgradient the polish does not model, so it applies only where
+    # the problem's L1 row is actually zero (per problem, so a batch
+    # mixing cost-free dates with costly ones still polishes the former).
     if params.polish:
-        x, z, w, y, mu = _polish(scaled, scaling, params, x, z, w, y, mu)
+        if l1_weight is None:
+            x, z, w, y, mu = _polish(scaled, scaling, params, x, z, w, y, mu)
+        else:
+            polished = _polish(scaled, scaling, params, x, z, w, y, mu)
+            has_l1 = jnp.any(l1w_s > 0)
+            x, z, w, y, mu = (
+                jnp.where(has_l1, raw, pol)
+                for raw, pol in zip((x, z, w, y, mu), polished)
+            )
 
     r_prim, r_dual, eps_p, eps_d, _, _ = _residuals(
         scaled, scaling, x, z, w, y, mu, params
@@ -81,8 +103,14 @@ def _solve_impl(qp: CanonicalQP,
     mu_u = (1.0 / scaling.c) * (1.0 / scaling.D) * mu * qp.var_mask
 
     obj = qp.objective_value(x_u)
+    if l1_weight is not None:
+        obj = obj + jnp.sum(l1_weight * jnp.abs(x_u - (
+            jnp.zeros_like(x_u) if l1_center is None else l1_center
+        )))
     # Duality gap: primal - dual objective = x'Px + q'x + support terms,
-    # computed against the original (unscaled) bounds.
+    # computed against the original (unscaled) bounds. (With an L1 term
+    # the box dual mu also carries the L1 subgradient, so the gap is an
+    # approximation there.)
     gap = jnp.abs(
         jnp.dot(x_u, qp.P @ x_u) + jnp.dot(qp.q, x_u)
         + _support(qp.u, qp.l, y_u) + _support(qp.ub, qp.lb, mu_u)
@@ -103,18 +131,31 @@ def _solve_impl(qp: CanonicalQP,
 def solve_qp(qp: CanonicalQP,
              params: SolverParams = SolverParams(),
              x0: Optional[jax.Array] = None,
-             y0: Optional[jax.Array] = None) -> QPSolution:
-    """Solve one canonical QP on device."""
-    return _solve_impl(qp, params, x0, y0)
+             y0: Optional[jax.Array] = None,
+             l1_weight: Optional[jax.Array] = None,
+             l1_center: Optional[jax.Array] = None) -> QPSolution:
+    """Solve one canonical QP on device.
+
+    ``l1_weight``/``l1_center`` add a native nonsmooth objective term
+    sum_i l1_weight_i |x_i - l1_center_i| (see
+    :func:`porqua_tpu.qp.admm.admm_solve`) — e.g. a turnover
+    transaction-cost with l1_center = previous holdings — without the
+    reference's 2x variable expansion (``qp_problems.py:120-157``).
+    """
+    return _solve_impl(qp, params, x0, y0, l1_weight, l1_center)
 
 
 @functools.partial(jax.jit, static_argnames=("params",))
 def solve_qp_batch(qp: CanonicalQP,
                    params: SolverParams = SolverParams(),
                    x0: Optional[jax.Array] = None,
-                   y0: Optional[jax.Array] = None) -> QPSolution:
+                   y0: Optional[jax.Array] = None,
+                   l1_weight: Optional[jax.Array] = None,
+                   l1_center: Optional[jax.Array] = None) -> QPSolution:
     """Solve a batch of canonical QPs (leading axis) in one XLA program."""
-    in_axes = (0, None if x0 is None else 0, None if y0 is None else 0)
+    in_axes = tuple(None if a is None else 0
+                    for a in (qp, x0, y0, l1_weight, l1_center))
     return jax.vmap(
-        lambda q, xx, yy: _solve_impl(q, params, xx, yy), in_axes=in_axes
-    )(qp, x0, y0)
+        lambda q, xx, yy, lw, lc: _solve_impl(q, params, xx, yy, lw, lc),
+        in_axes=(0,) + in_axes[1:],
+    )(qp, x0, y0, l1_weight, l1_center)
