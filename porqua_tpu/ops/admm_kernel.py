@@ -33,6 +33,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from porqua_tpu.qp.admm import l1_box_prox
+
 
 def _round_up(x: int, mult: int) -> int:
     return ((x + mult - 1) // mult) * mult
@@ -89,11 +91,7 @@ def _segment_kernel(Kinv_ref, C_ref, q_ref, l_ref, u_ref, lb_ref, ub_ref,
         z_new = jnp.clip(z_pre + y * inv_rho, l, u)
         y_new = y + rho * (z_pre - z_new)
         w_pre = al * xt + one_m_al * w
-        # Clipped shifted soft-threshold (identical to admm.one_iteration):
-        # exact prox of box + l1w*|.-l1c|; plain clip when l1w == 0.
-        s = w_pre + mu * inv_rhob - l1c
-        soft = jnp.sign(s) * jnp.maximum(jnp.abs(s) - l1w * inv_rhob, 0.0)
-        w_new = jnp.clip(l1c + soft, lb, ub)
+        w_new = l1_box_prox(w_pre + mu * inv_rhob, lb, ub, l1w * inv_rhob, l1c)
         mu_new = mu + rho_b * (w_pre - w_new)
         return (x_new, z_new, w_new, y_new, mu_new)
 

@@ -183,7 +183,13 @@ class Optimization(ABC):
             parts = lift.lift_turnover_constraint(parts, x_init, tocon["rhs"])
         levcon = self.constraints.l1.get("leverage")
         if levcon is not None:
+            # The lift rebuilds the parts dict; carry the native-L1 keys
+            # across it (they address the first n variables, which the
+            # leverage lift leaves in place before its aux block).
+            l1_keys = {k: parts[k] for k in ("l1_weight", "l1_center")
+                       if k in parts}
             parts = lift.lift_leverage_constraint(parts, levcon["rhs"])
+            parts.update(l1_keys)
 
         parts["constant"] = float(constant)
         return parts

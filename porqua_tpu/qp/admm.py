@@ -108,6 +108,20 @@ def _inf_norm(v):
     return jnp.max(jnp.abs(v)) if v.size else jnp.asarray(0.0, v.dtype)
 
 
+def l1_box_prox(v, lb, ub, l1w_over_rho, l1c):
+    """Exact prox of ``I_[lb,ub] + l1w |. - l1c|`` (elementwise).
+
+    Clipped shifted soft-threshold: in 1-D a convex objective restricted
+    to an interval attains its minimum at the projection of the
+    unconstrained minimizer. Reduces to the plain box projection when
+    the weight is zero. Shared by the XLA iteration and the Pallas
+    segment kernel so the two backends cannot drift.
+    """
+    s = v - l1c
+    soft = jnp.sign(s) * jnp.maximum(jnp.abs(s) - l1w_over_rho, 0.0)
+    return jnp.clip(l1c + soft, lb, ub)
+
+
 def _support(bound_u, bound_l, dual):
     """Support function of [l, u] at the dual direction, inf-safe."""
     pos = jnp.maximum(dual, 0.0)
@@ -268,11 +282,7 @@ def admm_solve(qp: CanonicalQP,
         y_new = y + rho * (alpha * zt + (1 - alpha) * z - z_new)
 
         w_arg = alpha * xt + (1 - alpha) * w + mu / rho_b
-        # Clipped shifted soft-threshold: exact prox of box + L1 term
-        # (reduces to the plain box projection when l1w == 0).
-        s = w_arg - l1c
-        soft = jnp.sign(s) * jnp.maximum(jnp.abs(s) - l1w / rho_b, 0.0)
-        w_new = jnp.clip(l1c + soft, qp.lb, qp.ub)
+        w_new = l1_box_prox(w_arg, qp.lb, qp.ub, l1w / rho_b, l1c)
         mu_new = mu + rho_b * (alpha * xt + (1 - alpha) * w - w_new)
         return (x_new, z_new, w_new, y_new, mu_new)
 

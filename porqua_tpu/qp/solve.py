@@ -81,11 +81,16 @@ def _solve_impl(qp: CanonicalQP,
         if l1_weight is None:
             x, z, w, y, mu = _polish(scaled, scaling, params, x, z, w, y, mu)
         else:
-            polished = _polish(scaled, scaling, params, x, z, w, y, mu)
+            # lax.cond skips the (expensive) LU polish at runtime when
+            # this problem's L1 row is live; under vmap it lowers to a
+            # select computing both branches, which is exactly the
+            # mixed-batch case where some dates need the polish.
             has_l1 = jnp.any(l1w_s > 0)
-            x, z, w, y, mu = (
-                jnp.where(has_l1, raw, pol)
-                for raw, pol in zip((x, z, w, y, mu), polished)
+            x, z, w, y, mu = jax.lax.cond(
+                has_l1,
+                lambda args: args,
+                lambda args: _polish(scaled, scaling, params, *args),
+                (x, z, w, y, mu),
             )
 
     r_prim, r_dual, eps_p, eps_d, _, _ = _residuals(

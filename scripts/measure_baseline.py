@@ -1,0 +1,178 @@
+"""Measure the BASELINE.json configs: reference-style serial CPU vs device.
+
+The reference publishes no numbers (SURVEY.md §6), so the baseline is
+*created* here: its solve path — a serial Python loop handing each
+date's dense QP to a compiled CPU solver (reference ``src/backtest.py:
+203`` -> ``src/qp_problems.py:211``) — is reproduced with this repo's
+native C++ ADMM core (qpsolvers/OSQP are not installed in this image;
+the C++ core plays the role of the compiled backend), and the TPU path
+is the batched jitted program.
+
+Usage:
+    python scripts/measure_baseline.py            # CPU baseline columns
+    PORQUA_MEASURE_DEVICE=1 python scripts/...    # + device columns (TPU)
+
+Prints one JSON object per config; paste into BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_DATES = int(os.environ.get("PORQUA_BASE_DATES", 252))
+N_ASSETS = int(os.environ.get("PORQUA_BASE_ASSETS", 500))
+WINDOW = int(os.environ.get("PORQUA_BASE_WINDOW", 252))
+SAMPLE = int(os.environ.get("PORQUA_BASE_SAMPLE", 8))
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def native_solver():
+    from porqua_tpu.native import solve_qp_native
+    return solve_qp_native
+
+
+def synth(seed=0):
+    rng = np.random.default_rng(seed)
+    F = rng.standard_normal((N_DATES, WINDOW, 8)) * 0.01
+    L = rng.standard_normal((N_DATES, 8, N_ASSETS))
+    X = np.einsum("btf,bfn->btn", F, L) + rng.standard_normal(
+        (N_DATES, WINDOW, N_ASSETS)) * 0.005
+    w = rng.dirichlet(np.ones(N_ASSETS), N_DATES)
+    y = np.einsum("btn,bn->bt", X, w) + rng.standard_normal(
+        (N_DATES, WINDOW)) * 0.001
+    return X, y
+
+
+def cpu_tracking(X, y, solve, tc=None, x0=None):
+    n = X.shape[1]
+    P = 2.0 * X.T @ X
+    q = -2.0 * X.T @ y
+    C = np.ones((1, n))
+    one = np.ones(1)
+    if tc:
+        # Reference-style lifted turnover objective (2n variables).
+        from porqua_tpu.qp import lift
+        parts = lift._as_parts(P, q, C, one, one, np.zeros(n), np.ones(n))
+        parts = lift.lift_turnover_objective(parts, x0, tc)
+        sol = solve(parts["P"], parts["q"], parts["C"], parts["l"],
+                    parts["u"], parts["lb"], parts["ub"],
+                    eps_abs=1e-5, eps_rel=1e-5)
+        return sol.x[:n]
+    sol = solve(P, q, C, one, one, np.zeros(n), np.ones(n),
+                eps_abs=1e-5, eps_rel=1e-5)
+    return sol.x
+
+
+def cpu_minvar(Sigma, solve):
+    n = Sigma.shape[0]
+    sol = solve(2.0 * Sigma, np.zeros(n), np.ones((1, n)), np.ones(1),
+                np.ones(1), np.zeros(n), np.ones(n),
+                eps_abs=1e-5, eps_rel=1e-5)
+    return sol.x
+
+
+def shrink_cov(X):
+    S = np.cov(X, rowvar=False)
+    mu = np.trace(S) / S.shape[0]
+    return 0.9 * S + 0.1 * mu * np.eye(S.shape[0])
+
+
+def measure(fn, n_rep=3):
+    times = []
+    for _ in range(n_rep):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    return min(times)
+
+
+def main():
+    solve = native_solver()
+    solve(np.eye(4), np.zeros(4), np.ones((1, 4)), np.ones(1), np.ones(1),
+          np.zeros(4), np.ones(4))  # force one-time g++ build
+    X, y = synth()
+    Xd, yd = X.astype(np.float64), y.astype(np.float64)
+    results = {}
+
+    # Config 1: single-date index-tracking QP.
+    te = [None]
+    def c1():
+        x = cpu_tracking(Xd[0], yd[0], solve)
+        te[0] = float(np.sqrt(np.mean((Xd[0] @ x - yd[0]) ** 2)))
+    results["1_single_tracking_cpu_s"] = round(measure(c1), 4)
+    results["1_te"] = round(te[0], 6)
+
+    # Config 2: min-variance long-only QP (shrinkage covariance).
+    Sigma = shrink_cov(Xd[0])
+    results["2_minvar_cpu_s"] = round(measure(lambda: cpu_minvar(Sigma, solve)), 4)
+
+    # Config 3: rolling backtest, serial loop over a date sample, extrapolated.
+    t0 = time.perf_counter()
+    tes = []
+    for i in range(SAMPLE):
+        x = cpu_tracking(Xd[i], yd[i], solve)
+        tes.append(float(np.sqrt(np.mean((Xd[i] @ x - yd[i]) ** 2))))
+    sample_s = time.perf_counter() - t0
+    results["3_backtest_cpu_s"] = round(sample_s * N_DATES / SAMPLE, 2)
+    results["3_te_median"] = round(float(np.median(tes)), 6)
+
+    # Config 4: tracking + turnover cost (lifted, 2n vars) + screening.
+    x0 = np.full(N_ASSETS, 1.0 / N_ASSETS)
+    t0 = time.perf_counter()
+    for i in range(max(SAMPLE // 2, 2)):
+        cpu_tracking(Xd[i], yd[i], solve, tc=0.002, x0=x0)
+    sample_s = time.perf_counter() - t0
+    results["4_turnover_cpu_s"] = round(
+        sample_s * N_DATES / max(SAMPLE // 2, 2), 2)
+
+    # Config 5: multi-benchmark MSCI tracking (24 benchmarks x dates).
+    rng = np.random.default_rng(5)
+    n5, t5, b5 = 24, 252, 24
+    X5 = rng.standard_normal((t5, n5)) * 0.01
+    t0 = time.perf_counter()
+    for b in range(b5):
+        wb = rng.dirichlet(np.ones(n5))
+        y5 = X5 @ wb
+        cpu_tracking(X5, y5, solve)
+    results["5_multibench_cpu_s"] = round(
+        (time.perf_counter() - t0) * N_DATES / b5, 2)  # scaled to dates axis
+
+    if os.environ.get("PORQUA_MEASURE_DEVICE"):
+        import jax
+        import jax.numpy as jnp
+        from porqua_tpu.qp.solve import SolverParams
+        from porqua_tpu.tracking import tracking_step_jit
+
+        dev = jax.devices()[0]
+        results["device"] = f"{dev.platform}:{dev.device_kind}"
+        Xs = jnp.asarray(X, jnp.float32)
+        ys = jnp.asarray(y, jnp.float32)
+        params = SolverParams(max_iter=2000, eps_abs=1e-3, eps_rel=1e-3)
+        out = tracking_step_jit(Xs, ys, params)
+        jax.block_until_ready(out)
+
+        def dev_run():
+            o = tracking_step_jit(Xs, ys, params)
+            jax.block_until_ready(o)
+        results["3_backtest_dev_s"] = round(measure(dev_run), 4)
+        results["3_dev_te_median"] = round(
+            float(jnp.median(out.tracking_error)), 6)
+        results["3_dev_solved"] = int(np.sum(np.asarray(out.status) == 1))
+        results["1_single_dev_s"] = round(
+            results["3_backtest_dev_s"] / N_DATES, 6)
+
+    print(json.dumps(results, indent=2))
+
+
+if __name__ == "__main__":
+    main()

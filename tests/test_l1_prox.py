@@ -161,3 +161,41 @@ class TestOptimizationL1Native:
                 [opt.results["weights"][a] for a in cols])
 
         np.testing.assert_allclose(weights[True], weights[False], atol=2e-5)
+
+    def test_l1_native_survives_leverage_lift(self, rng):
+        """A leverage constraint must not drop the native cost term
+        (the lift rebuilds the parts dict; the L1 keys carry across)."""
+        import pandas as pd
+
+        from porqua_tpu.constraints import Constraints
+        from porqua_tpu.optimization import LeastSquares
+        from porqua_tpu.optimization_data import OptimizationData
+
+        n, T = 8, 100
+        dates = pd.bdate_range("2021-01-01", periods=T)
+        cols = [f"A{i}" for i in range(n)]
+        X = pd.DataFrame(rng.standard_normal((T, n)) * 0.01,
+                         index=dates, columns=cols)
+        y = pd.DataFrame(
+            {"bm": X.to_numpy() @ rng.dirichlet(np.ones(n))}, index=dates)
+        od = OptimizationData(return_series=X, bm_series=y, align=True)
+        x0 = {c: 1.0 / n for c in cols}
+
+        weights = {}
+        for native in (False, True):
+            opt = LeastSquares(
+                transaction_cost=0.005, x0=x0, l1_native=native,
+                eps_abs=1e-8, eps_rel=1e-8, max_iter=40000,
+                dtype=np.float64,
+            )
+            c = Constraints(selection=cols)
+            c.add_budget()
+            c.add_box(box_type="LongShort", lower=-0.5, upper=1.0)
+            c.add_l1("leverage", rhs=1.4)
+            opt.constraints = c
+            opt.set_objective(od)
+            assert opt.solve()
+            weights[native] = np.array(
+                [opt.results["weights"][a] for a in cols])
+
+        np.testing.assert_allclose(weights[True], weights[False], atol=5e-5)
