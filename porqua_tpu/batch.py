@@ -170,6 +170,51 @@ def solve_scan_turnover(qp: CanonicalQP,
     return sols
 
 
+def solve_scan_l1(qp: CanonicalQP,
+                  n_assets: int,
+                  w_init: jax.Array,
+                  transaction_cost: float,
+                  params: SolverParams = SolverParams()) -> QPSolution:
+    """Turnover-cost-coupled dates via ``lax.scan`` with the native prox.
+
+    The sequential analog of :func:`solve_scan_turnover` for the
+    *objective* cost term: each date pays
+    ``transaction_cost * |w - w_prev|_1`` against the previous date's
+    *solved* weights, handled by the solver's L1 prox at n variables
+    (no lifted aux block, so the scan carries only the l1 center and the
+    warm-start vectors). This is the fully-on-device version of the
+    reference's date-chained ``x0`` transaction-cost backtest
+    (reference ``optimization.py:126-137`` + ``qp_problems.py:120-157``).
+
+    ``qp`` is a stacked batch (leading axis = dates) of problems over
+    the SAME, identically-ordered asset universe: the carry is
+    positional, so variable j must mean the same asset on every date —
+    a date-varying selection charges costs between unrelated assets
+    with no error (build with a fixed universe, masking exits via
+    lb = ub = 0, when chaining costs). ``w_init`` is the pre-backtest
+    holdings vector (zeros for a cash start), padded to the problem's n.
+    """
+    dtype = qp.P.dtype
+    nvar, m = qp.P.shape[-1], qp.C.shape[-2]
+    tc = jnp.asarray(transaction_cost, dtype)
+    l1w = jnp.where(jnp.arange(nvar) < n_assets, tc, jnp.asarray(0.0, dtype))
+
+    def step(carry, qp_t):
+        w_prev, x_prev, y_prev = carry
+        sol = _solve_impl(qp_t, params, x_prev, y_prev,
+                          l1_weight=l1w, l1_center=w_prev)
+        ok = sol.status == Status.SOLVED
+        w_carry = jnp.where(ok, sol.x, w_prev)
+        return (w_carry, sol.x, sol.y), sol
+
+    w0 = jnp.zeros(nvar, dtype).at[:n_assets].set(
+        jnp.asarray(w_init, dtype)[:n_assets]
+    )
+    init = (w0, jnp.zeros(nvar, dtype), jnp.zeros(m, dtype))
+    _, sols = jax.lax.scan(step, init, qp)
+    return sols
+
+
 def to_strategy(problems: BatchProblems, solution: QPSolution) -> Strategy:
     """Convert batched device results into the host ``Strategy`` object."""
     xs = np.asarray(solution.x)

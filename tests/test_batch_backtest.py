@@ -195,3 +195,48 @@ def test_zero_transaction_cost_uses_turnover_constraint(rng):
     w = pd.Series(opt.results["weights"])
     assert abs(w.sum() - 1.0) < 1e-6
     assert np.abs(w - 0.2).sum() <= 0.5 + 1e-6
+
+
+def test_scan_l1_matches_serial_cost_chain(rng):
+    """Native-prox cost-coupled dates: lax.scan carrying l1_center =
+    previous solved weights matches a serial loop of prox solves."""
+    from porqua_tpu.batch import solve_scan_l1
+
+    n, n_dates, tc = 6, 4, 0.01
+    qps = []
+    Ps, qs = [], []
+    for _ in range(n_dates):
+        X = rng.standard_normal((60, n)) * 0.01
+        P = 2 * X.T @ X + 1e-6 * np.eye(n)
+        q = -0.02 * rng.random(n)
+        Ps.append(P)
+        qs.append(q)
+        qps.append(CanonicalQP.build(
+            P, q, C=np.ones((1, n)), l=np.ones(1), u=np.ones(1),
+            lb=np.zeros(n), ub=np.ones(n), dtype=jnp.float64,
+        ))
+
+    w_start = np.full(n, 1.0 / n)
+
+    # Serial reference: prox solve per date with the previous solution.
+    x_prev = w_start
+    serial_ws = []
+    for d in range(n_dates):
+        sol = solve_qp(
+            qps[d], TIGHT,
+            l1_weight=jnp.full(n, tc, jnp.float64),
+            l1_center=jnp.asarray(x_prev),
+        )
+        assert int(sol.status) == Status.SOLVED
+        x_prev = np.asarray(sol.x)[:n]
+        serial_ws.append(x_prev)
+
+    sols = solve_scan_l1(
+        stack_qps(qps), n_assets=n, w_init=w_start,
+        transaction_cost=tc, params=TIGHT,
+    )
+    for d in range(n_dates):
+        assert int(sols.status[d]) == Status.SOLVED
+        np.testing.assert_allclose(
+            np.asarray(sols.x[d])[:n], serial_ws[d], atol=1e-5
+        )
