@@ -99,6 +99,32 @@ def tracking_step_jit(Xs, ys, params: SolverParams = SolverParams(), ridge: floa
     return tracking_step(Xs, ys, params, ridge)
 
 
+def synthetic_universe_np(seed: int,
+                          n_dates: int,
+                          window: int,
+                          n_assets: int,
+                          n_factors: int = 8):
+    """Numpy twin of :func:`synthetic_universe` (same factor model,
+    numpy RNG) for host-side baselines that must not initialize a JAX
+    backend — e.g. ``bench.py``'s serial CPU reference loop. Returns
+    float32 ``(Xs, ys)`` numpy arrays.
+    """
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    factors = rng.standard_normal((n_dates, window, n_factors)).astype(
+        np.float32) * 0.01
+    loadings = rng.standard_normal((n_dates, n_factors, n_assets)).astype(
+        np.float32)
+    idio = rng.standard_normal((n_dates, window, n_assets)).astype(
+        np.float32) * 0.005
+    Xs = np.einsum("btf,bfn->btn", factors, loadings) + idio
+    w_true = rng.dirichlet(np.ones(n_assets), n_dates).astype(np.float32)
+    ys = np.einsum("btn,bn->bt", Xs, w_true)
+    ys = ys + rng.standard_normal(ys.shape).astype(np.float32) * 0.001
+    return Xs, ys
+
+
 def synthetic_universe(key: jax.Array,
                        n_dates: int,
                        window: int,
