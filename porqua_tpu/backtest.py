@@ -1,16 +1,17 @@
-"""Rolling-rebalance backtest engine.
+"""Rolling-rebalance backtest engine (host-side orchestration).
 
-Mirror of reference ``src/backtest.py`` (``BacktestData``,
-``BacktestService``, ``Backtest.run``, ``append_custom``) with the same
-orchestration semantics: per date, run selection builders, reset
-constraints, run optimization builders, set objective, solve, append the
-portfolio.
+Covers the reference engine's capabilities
+(``/root/reference/src/backtest.py``: a service object holding data +
+per-date builder hooks + the optimizer, and a driver that walks the
+rebalance calendar) with a leaner architecture: the service is a
+dataclass whose validation happens once at construction, and rebalance
+failures propagate with their original traceback instead of being
+flattened into a bare RuntimeError.
 
 Two execution modes:
 
-* :meth:`Backtest.run` — the serial compat loop (reference
-  ``backtest.py:201-224``), now warm-starting each date's ADMM solve
-  from the previous solution;
+* :meth:`Backtest.run` — the serial compat loop, warm-starting each
+  date's ADMM solve from the previous solution;
 * the fully-batched device path in :mod:`porqua_tpu.batch` — pass 1
   runs all builders host-side to produce padded (dates x ...) tensors,
   pass 2 solves every date in one XLA program via ``vmap`` (or
@@ -21,6 +22,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import warnings
 from typing import Optional
 
 import pandas as pd
@@ -34,110 +36,64 @@ from porqua_tpu.selection import Selection
 
 
 class BacktestData(dict):
-    """Data container. The reference ships an empty marker class
-    (``backtest.py:36-39``) and notebooks pass plain dicts; a dict
-    subclass supports both styles."""
+    """Loose data bag (return_series, bm_series, volume_series, ...).
+
+    The reference ships an empty marker class and its notebooks pass
+    plain dicts; a dict subclass accepts both styles."""
 
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
         self.__dict__ = self
 
 
-class BacktestService:
+def _expect(value, kind, what: str):
+    if not isinstance(value, kind):
+        raise TypeError(f"{what} must be a {kind.__name__}, "
+                        f"got {type(value).__name__}")
+    return value
 
-    def __init__(self,
-                 data,
-                 selection_item_builders: dict,
-                 optimization_item_builders: dict,
-                 optimization: Optional[Optimization] = None,
-                 settings: Optional[dict] = None,
-                 **kwargs) -> None:
+
+class BacktestService:
+    """Everything one backtest needs: data, per-date builder hooks, the
+    optimizer, and settings. Builders run per rebalance date in two
+    stages — selection filters first, then optimization items against
+    the fresh constraint set. Validation happens once, here, instead of
+    through per-attribute property setters."""
+
+    def __init__(self, data, selection_item_builders,
+                 optimization_item_builders, optimization=None,
+                 settings=None, **kwargs):
         self.data = data
-        self.optimization = optimization if optimization is not None else EmptyOptimization()
+        self.optimization = (EmptyOptimization() if optimization is None
+                             else _expect(optimization, Optimization,
+                                          "'optimization'"))
+        _expect(selection_item_builders, dict, "'selection_item_builders'")
+        for v in selection_item_builders.values():
+            _expect(v, SelectionItemBuilder,
+                    "each selection item builder")
+        _expect(optimization_item_builders, dict,
+                "'optimization_item_builders'")
+        for v in optimization_item_builders.values():
+            _expect(v, OptimizationItemBuilder,
+                    "each optimization item builder")
         self.selection_item_builders = selection_item_builders
         self.optimization_item_builders = optimization_item_builders
-        self.settings = settings if settings is not None else {}
+        self.settings = dict(settings) if settings else {}
         self.settings.update(kwargs)
         self.selection = Selection()
         self.optimization_data = OptimizationData([])
 
-    @property
-    def data(self):
-        return self._data
-
-    @data.setter
-    def data(self, value):
-        self._data = value
-
-    @property
-    def selection(self):
-        return self._selection
-
-    @selection.setter
-    def selection(self, value):
-        if not isinstance(value, Selection):
-            raise TypeError("Expected a Selection instance for 'selection'")
-        self._selection = value
-
-    @property
-    def selection_item_builders(self):
-        return self._selection_item_builders
-
-    @selection_item_builders.setter
-    def selection_item_builders(self, value):
-        if not isinstance(value, dict) or not all(
-            isinstance(v, SelectionItemBuilder) for v in value.values()
-        ):
-            raise TypeError(
-                "Expected a dictionary containing SelectionItemBuilder instances "
-                "for 'selection_item_builders'"
-            )
-        self._selection_item_builders = value
-
-    @property
-    def optimization(self):
-        return self._optimization
-
-    @optimization.setter
-    def optimization(self, value):
-        if not isinstance(value, Optimization):
-            raise TypeError("Expected an Optimization instance for 'optimization'")
-        self._optimization = value
-
-    @property
-    def optimization_item_builders(self):
-        return self._optimization_item_builders
-
-    @optimization_item_builders.setter
-    def optimization_item_builders(self, value):
-        if not isinstance(value, dict) or not all(
-            isinstance(v, OptimizationItemBuilder) for v in value.values()
-        ):
-            raise TypeError(
-                "Expected a dictionary containing OptimizationItemBuilder instances "
-                "for 'optimization_item_builders'"
-            )
-        self._optimization_item_builders = value
-
-    @property
-    def settings(self):
-        return self._settings
-
-    @settings.setter
-    def settings(self, value):
-        if not isinstance(value, dict):
-            raise TypeError("Expected a dictionary for 'settings'")
-        self._settings = value
-
     def build_selection(self, rebdate: str) -> None:
-        for key, item_builder in self.selection_item_builders.items():
-            item_builder.arguments["item_name"] = key
-            item_builder(self, rebdate)
+        for name, builder in self.selection_item_builders.items():
+            builder.arguments["item_name"] = name
+            builder(self, rebdate)
 
     def build_optimization(self, rebdate: str) -> None:
-        self.optimization.constraints = Constraints(selection=self.selection.selected)
-        for item_builder in self.optimization_item_builders.values():
-            item_builder(self, rebdate)
+        # Fresh constraint set over the universe selection just decided.
+        self.optimization.constraints = Constraints(
+            selection=self.selection.selected)
+        for builder in self.optimization_item_builders.values():
+            builder(self, rebdate)
 
     def prepare_rebalancing(self, rebalancing_date: str) -> None:
         self.build_selection(rebdate=rebalancing_date)
@@ -145,84 +101,70 @@ class BacktestService:
 
 
 class Backtest:
+    """Serial rebalance driver + output store."""
 
     def __init__(self) -> None:
         self._strategy = Strategy([])
-        self._output = {}
+        self._output: dict = {}
 
     @property
-    def strategy(self):
+    def strategy(self) -> Strategy:
         return self._strategy
 
     @property
-    def output(self):
+    def output(self) -> dict:
         return self._output
 
     def append_output(self, date_key=None, output_key=None, value=None):
         if value is None:
             return True
-        if date_key in self.output.keys():
-            if output_key in self.output[date_key].keys():
-                raise Warning(
-                    f"Output key '{output_key}' for date key '{date_key}' "
-                    "already exists and will be overwritten."
-                )
-            self.output[date_key][output_key] = value
-        else:
-            self.output[date_key] = {output_key: value}
+        slot = self._output.setdefault(date_key, {})
+        if output_key in slot:
+            warnings.warn(
+                f"overwriting output {output_key!r} for {date_key!r}")
+        slot[output_key] = value
         return True
 
     def rebalance(self, bs: BacktestService, rebalancing_date: str) -> None:
+        """One date: selection -> constraints -> objective -> solve.
+        Exceptions propagate unwrapped — the reference's blanket
+        ``raise RuntimeError(error)`` (``backtest.py:193-197``) loses
+        the traceback and is deliberately not replicated."""
         bs.prepare_rebalancing(rebalancing_date=rebalancing_date)
-        try:
-            bs.optimization.set_objective(optimization_data=bs.optimization_data)
-            bs.optimization.solve()
-        except Exception as error:
-            raise RuntimeError(error)
+        bs.optimization.set_objective(optimization_data=bs.optimization_data)
+        bs.optimization.solve()
 
     def run(self, bs: BacktestService) -> None:
-        """Serial compat loop (reference ``backtest.py:201-224``), with
-        warm starts chained between consecutive dates."""
-        for rebalancing_date in bs.settings["rebdates"]:
+        """Serial compat loop, chaining warm starts between dates."""
+        for date in bs.settings["rebdates"]:
             if not bs.settings.get("quiet"):
-                print(f"Rebalancing date: {rebalancing_date}")
+                print(f"Rebalancing date: {date}")
 
-            self.rebalance(bs=bs, rebalancing_date=rebalancing_date)
+            self.rebalance(bs=bs, rebalancing_date=date)
 
             weights = bs.optimization.results["weights"]
-            portfolio = Portfolio(rebalancing_date=rebalancing_date, weights=weights)
-            self.strategy.portfolios.append(portfolio)
+            self.strategy.portfolios.append(
+                Portfolio(rebalancing_date=date, weights=weights))
 
-            # Chain the previous weights for warm starts / turnover builders
             if bs.optimization.results.get("status"):
                 bs.settings["prev_weights"] = weights
 
-            append_fun = bs.settings.get("append_fun")
-            if append_fun is not None:
-                append_fun(
-                    backtest=self,
-                    bs=bs,
-                    rebalancing_date=rebalancing_date,
-                    what=bs.settings.get("append_fun_args"),
-                )
+            hook = bs.settings.get("append_fun")
+            if hook is not None:
+                hook(backtest=self, bs=bs, rebalancing_date=date,
+                     what=bs.settings.get("append_fun_args"))
 
     def save(self, filename: str, path: Optional[str] = None) -> None:
-        try:
-            if path is not None and filename is not None:
-                filename = os.path.join(path, filename)
-            with open(filename, "wb") as f:
-                pickle.dump(self, f, protocol=pickle.HIGHEST_PROTOCOL)
-        except Exception as ex:
-            print("Error during pickling object:", ex)
+        target = os.path.join(path, filename) if path else filename
+        with open(target, "wb") as f:
+            pickle.dump(self, f, protocol=pickle.HIGHEST_PROTOCOL)
 
     @staticmethod
     def load(filename: str, path: Optional[str] = None) -> "Backtest":
-        """Resume support (the reference's ``QuadraticProgram.load`` is
-        broken — ``qp_problems.py:229-230`` passes the path string to
-        ``pickle.load``; fixed here)."""
-        if path is not None:
-            filename = os.path.join(path, filename)
-        with open(filename, "rb") as f:
+        """(The reference's pickle loader passes the path string to
+        ``pickle.load`` — ``qp_problems.py:229-230`` — fixed here.)"""
+        target = os.path.join(path, filename) if path else filename
+        with open(target, "rb") as f:
             return pickle.load(f)
 
 
@@ -230,29 +172,19 @@ def append_custom(backtest: Backtest,
                   bs: BacktestService,
                   rebalancing_date: Optional[str] = None,
                   what: Optional[list] = None) -> None:
-    """Per-date output recorder for percentile backtests
-    (reference ``backtest.py:245-270``)."""
-    if what is None:
-        what = ["w_dict", "objective"]
-
-    for key in what:
+    """Per-date output recorder for percentile backtests: stores each
+    bucket's weight Series (key ``weights_<bucket>``) and any other
+    requested result fields."""
+    for key in (what if what is not None else ["w_dict", "objective"]):
         if key == "w_dict":
-            w_dict = bs.optimization.results["w_dict"]
-            for wkey in w_dict.keys():
-                weights = w_dict[wkey]
-                if hasattr(weights, "to_dict"):
-                    weights = weights.to_dict()
-                portfolio = Portfolio(rebalancing_date=rebalancing_date, weights=weights)
+            for bucket, bucket_weights in \
+                    bs.optimization.results["w_dict"].items():
                 backtest.append_output(
                     date_key=rebalancing_date,
-                    output_key=f"weights_{wkey}",
-                    value=pd.Series(portfolio.weights),
-                )
-        else:
-            if key not in bs.optimization.results.keys():
-                continue
+                    output_key=f"weights_{bucket}",
+                    value=pd.Series(dict(bucket_weights)))
+        elif key in bs.optimization.results:
             backtest.append_output(
                 date_key=rebalancing_date,
                 output_key=key,
-                value=bs.optimization.results[key],
-            )
+                value=bs.optimization.results[key])

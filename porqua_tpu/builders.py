@@ -1,36 +1,37 @@
 """Backtest item builders — the per-rebalance-date plug-in API.
 
-Mirror of reference ``src/builders.py``: ``SelectionItemBuilder`` runs a
-``bibfn`` returning a named filter; ``OptimizationItemBuilder`` runs a
-``bibfn`` for side effects on the backtest service (optimization data,
-constraints). This is the reference's main extensibility point and is
-preserved as-is; the batched device backtest
-(:mod:`porqua_tpu.batch`) runs the same builders host-side for all
-dates in pass 1, then lowers the results to padded device arrays.
+Covers the reference's builder hooks
+(``/root/reference/src/builders.py``: selection builders return a named
+filter, optimization builders mutate the service) with simpler
+plumbing: a builder is just a stored callable plus its keyword
+arguments — no abstract base, no property indirection. The callable
+convention (``bibfn(bs, rebdate, **kwargs)``) is unchanged, so user
+bibfns written against the reference drop in as-is.
 
-Stale reference bibfns are fixed here (SURVEY.md section 2):
-``bibfn_selection_min_volume`` returns its filter instead of touching a
-nonexistent ``bs.rebalancing`` (reference ``builders.py:118``);
-``bibfn_selection_ltr`` is provided in :mod:`porqua_tpu.models.ltr`
+Stale reference bibfns are fixed rather than ported (SURVEY.md
+section 2): the min-volume filter returns its filter instead of
+touching a nonexistent service attribute (reference ``builders.py:118``),
+and learning-to-rank scoring lives in :mod:`porqua_tpu.models.ltr`
 with the undefined-variable bugs fixed.
 """
 
 from __future__ import annotations
 
-from abc import ABC, abstractmethod
-from typing import Any
+from typing import Callable, Optional
 
 import numpy as np
 import pandas as pd
 
 
-class BacktestItemBuilder(ABC):
-    """Holds kwargs in ``.arguments``; callable per rebalance date
-    (reference ``builders.py:35-51``)."""
+class BacktestItemBuilder:
+    """A per-date hook: ``bibfn`` plus the kwargs it is called with.
 
-    def __init__(self, **kwargs):
-        self._arguments = {}
-        self._arguments.update(kwargs)
+    ``arguments`` is a plain mutable dict; the backtest loop injects
+    ``item_name`` into it before each call.
+    """
+
+    def __init__(self, bibfn: Optional[Callable] = None, **kwargs):
+        self._arguments = dict(kwargs, bibfn=bibfn)
 
     @property
     def arguments(self) -> dict:
@@ -40,29 +41,33 @@ class BacktestItemBuilder(ABC):
     def arguments(self, value: dict) -> None:
         self._arguments = value
 
-    @abstractmethod
-    def __call__(self, service, rebdate: str) -> None:
-        raise NotImplementedError("Method '__call__' must be implemented in derived class.")
+    def _fn(self) -> Callable:
+        fn = self._arguments.get("bibfn")
+        if not callable(fn):
+            raise ValueError(
+                f"{type(self).__name__} needs a callable 'bibfn'")
+        return fn
+
+    def __call__(self, bs, rebdate: str) -> None:
+        raise NotImplementedError
 
 
 class SelectionItemBuilder(BacktestItemBuilder):
+    """Runs its bibfn and registers the returned Series/DataFrame as a
+    named selection filter."""
 
     def __call__(self, bs, rebdate: str) -> None:
-        selection_item_builder_fn = self.arguments.get("bibfn")
-        if selection_item_builder_fn is None or not callable(selection_item_builder_fn):
-            raise ValueError("bibfn is not defined or not callable.")
-        item_value = selection_item_builder_fn(bs=bs, rebdate=rebdate, **self.arguments)
-        item_name = self.arguments.get("item_name")
-        bs.selection.add_filtered(filter_name=item_name, value=item_value)
+        item = self._fn()(bs=bs, rebdate=rebdate, **self.arguments)
+        bs.selection.add_filtered(
+            filter_name=self.arguments.get("item_name"), value=item)
 
 
 class OptimizationItemBuilder(BacktestItemBuilder):
+    """Runs its bibfn for side effects on the service (optimization
+    data windows, constraint rows)."""
 
     def __call__(self, bs, rebdate: str) -> None:
-        optimization_item_builder_fn = self.arguments.get("bibfn")
-        if optimization_item_builder_fn is None or not callable(optimization_item_builder_fn):
-            raise ValueError("bibfn is not defined or not callable.")
-        optimization_item_builder_fn(bs=bs, rebdate=rebdate, **self.arguments)
+        self._fn()(bs=bs, rebdate=rebdate, **self.arguments)
 
 
 # --------------------------------------------------------------------------
@@ -70,33 +75,30 @@ class OptimizationItemBuilder(BacktestItemBuilder):
 # --------------------------------------------------------------------------
 
 def bibfn_selection_data(bs, rebdate: str, **kwargs) -> pd.Series:
-    """All assets with return data (reference ``builders.py:124-135``)."""
-    data = bs.data.get("return_series")
-    if data is None:
-        raise ValueError("Return series data is missing.")
-    return pd.Series(np.ones(data.shape[1], dtype=int), index=data.columns, name="binary")
+    """Admit every asset the return series covers."""
+    returns = bs.data.get("return_series")
+    if returns is None:
+        raise ValueError("the service data lacks 'return_series'")
+    return pd.Series(1, index=returns.columns, name="binary")
 
 
 def bibfn_selection_min_volume(bs, rebdate: str, **kwargs) -> pd.Series:
-    """Median-volume floor filter (reference ``builders.py:100-120``, with
-    the stale service mutation removed — it *returns* the filter)."""
+    """Admit assets whose aggregate trailing volume clears a floor."""
     width = kwargs.get("width", 365)
     agg_fn = kwargs.get("agg_fn", np.median)
-    min_volume = kwargs.get("min_volume", 500_000)
+    floor = kwargs.get("min_volume", 500_000)
 
-    vol = bs.data.get("volume_series")
-    if vol is None:
-        raise ValueError("Volume series data is missing.")
-    window = vol[vol.index <= rebdate].tail(width).fillna(0)
-    agg = window.apply(agg_fn, axis=0)
-    binary = (agg >= min_volume).astype(int)
-    binary.name = "binary"
-    return binary
+    volume = bs.data.get("volume_series")
+    if volume is None:
+        raise ValueError("the service data lacks 'volume_series'")
+    trailing = volume.loc[volume.index <= rebdate].tail(width).fillna(0)
+    admitted = trailing.apply(agg_fn, axis=0) >= floor
+    return admitted.astype(int).rename("binary")
 
 
 def bibfn_selection_ltr(bs, rebdate: str, **kwargs) -> pd.DataFrame:
-    """Learning-to-rank scoring filter; delegates to the models subpackage
-    (reference ``builders.py:138-180``, stale-code bugs fixed there)."""
+    """Learning-to-rank scoring filter (see
+    :func:`porqua_tpu.models.ltr.ltr_selection_scores`)."""
     from porqua_tpu.models.ltr import ltr_selection_scores
 
     return ltr_selection_scores(bs=bs, rebdate=rebdate, **kwargs)
@@ -106,45 +108,45 @@ def bibfn_selection_ltr(bs, rebdate: str, **kwargs) -> pd.DataFrame:
 # Optimization-data bibfns
 # --------------------------------------------------------------------------
 
+def _trailing_weekdays(frame: pd.DataFrame, rebdate: str,
+                       width: Optional[int]) -> pd.DataFrame:
+    """Last ``width`` rows at or before ``rebdate``, weekends dropped."""
+    window = frame.loc[frame.index <= rebdate].tail(width)
+    return window.loc[window.index.dayofweek < 5]
+
+
 def bibfn_return_series(bs, rebdate: str, **kwargs) -> None:
-    """Trailing-window per-universe returns, weekends dropped
-    (reference ``builders.py:188-215``)."""
-    width = kwargs.get("width")
-    ids = bs.selection.selected
-    data = bs.data.get("return_series")
-    if data is None:
-        raise ValueError("Return series data is missing.")
-    return_series = data[data.index <= rebdate].tail(width)[ids]
-    return_series = return_series[return_series.index.dayofweek < 5]
-    bs.optimization_data["return_series"] = return_series
+    """Trailing return window over the selected universe."""
+    returns = bs.data.get("return_series")
+    if returns is None:
+        raise ValueError("the service data lacks 'return_series'")
+    window = _trailing_weekdays(returns, rebdate, kwargs.get("width"))
+    bs.optimization_data["return_series"] = window[bs.selection.selected]
 
 
 def bibfn_bm_series(bs, rebdate: str, **kwargs) -> None:
-    """Benchmark window + optional date alignment
-    (reference ``builders.py:218-251``)."""
-    width = kwargs.get("width")
-    align = kwargs.get("align")
-    data = bs.data.get("bm_series")
-    if data is None:
-        raise ValueError("Benchmark return series data is missing.")
-    bm_series = data[data.index <= rebdate].tail(width)
-    bm_series = bm_series[bm_series.index.dayofweek < 5]
-    bs.optimization_data["bm_series"] = bm_series
-    if align:
+    """Trailing benchmark window, optionally date-aligned with the
+    return window."""
+    bm = bs.data.get("bm_series")
+    if bm is None:
+        raise ValueError("the service data lacks 'bm_series'")
+    bs.optimization_data["bm_series"] = _trailing_weekdays(
+        bm, rebdate, kwargs.get("width"))
+    if kwargs.get("align"):
         bs.optimization_data.align_dates(
-            variable_names=["bm_series", "return_series"], dropna=True
-        )
+            variable_names=["bm_series", "return_series"], dropna=True)
 
 
 def bibfn_scores(bs, rebdate: str, **kwargs) -> None:
-    """Expose a trailing window of a scores frame to the optimizer."""
-    data = bs.data.get("scores")
-    if data is None:
-        raise ValueError("Scores data is missing.")
-    ids = bs.selection.selected
-    scores = data[data.index <= rebdate]
-    bs.optimization_data["scores"] = scores.iloc[[-1]][ids].T.squeeze(axis=1).to_frame("score") \
-        if isinstance(scores, pd.DataFrame) else scores
+    """Expose the latest row of a scores frame over the universe."""
+    scores = bs.data.get("scores")
+    if scores is None:
+        raise ValueError("the service data lacks 'scores'")
+    if isinstance(scores, pd.DataFrame):
+        latest = scores.loc[scores.index <= rebdate].iloc[[-1]]
+        scores = latest[bs.selection.selected].T.squeeze(
+            axis=1).to_frame("score")
+    bs.optimization_data["scores"] = scores
 
 
 # --------------------------------------------------------------------------
@@ -152,26 +154,26 @@ def bibfn_scores(bs, rebdate: str, **kwargs) -> None:
 # --------------------------------------------------------------------------
 
 def bibfn_budget_constraint(bs, rebdate: str, **kwargs) -> None:
-    budget = kwargs.get("budget", 1)
-    bs.optimization.constraints.add_budget(rhs=budget, sense="=")
+    bs.optimization.constraints.add_budget(
+        rhs=kwargs.get("budget", 1), sense="=")
 
 
 def bibfn_box_constraints(bs, rebdate: str, **kwargs) -> None:
-    lower = kwargs.get("lower", 0)
-    upper = kwargs.get("upper", 1)
-    box_type = kwargs.get("box_type", "LongOnly")
-    bs.optimization.constraints.add_box(box_type=box_type, lower=lower, upper=upper)
+    bs.optimization.constraints.add_box(
+        box_type=kwargs.get("box_type", "LongOnly"),
+        lower=kwargs.get("lower", 0),
+        upper=kwargs.get("upper", 1))
 
 
 def bibfn_turnover_constraint(bs, rebdate: str, **kwargs) -> None:
-    """Turnover budget vs the previous (drifted) portfolio. The previous
-    weights are read from ``bs.settings['prev_weights']``, maintained by
-    the backtest loop."""
-    budget = kwargs.get("turnover_budget", 1.0)
-    x0 = bs.settings.get("prev_weights") or {}
-    bs.optimization.constraints.add_l1("turnover", rhs=budget, x0=dict(x0))
+    """Turnover budget vs the previous portfolio (read from
+    ``bs.settings['prev_weights']``, maintained by the backtest loop)."""
+    bs.optimization.constraints.add_l1(
+        "turnover",
+        rhs=kwargs.get("turnover_budget", 1.0),
+        x0=dict(bs.settings.get("prev_weights") or {}))
 
 
 def bibfn_leverage_constraint(bs, rebdate: str, **kwargs) -> None:
-    budget = kwargs.get("leverage_budget", 2.0)
-    bs.optimization.constraints.add_l1("leverage", rhs=budget)
+    bs.optimization.constraints.add_l1(
+        "leverage", rhs=kwargs.get("leverage_budget", 2.0))

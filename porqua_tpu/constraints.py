@@ -1,18 +1,21 @@
 """Declarative portfolio-constraint container and canonicalization.
 
-Host-side mirror of the reference's constraints DSL
-(``/root/reference/src/constraints.py``): budget (eq/ineq), box
-(LongOnly / LongShort / Unbounded), arbitrary linear rows with
-``=``/``<=``/``>=`` senses, and symbolic L1 constraints (turnover,
-leverage). Two lowerings are provided:
+Covers the same capability surface as the reference's constraints layer
+(``/root/reference/src/constraints.py``: budget, box, linear rows with
+``=``/``<=``/``>=`` senses, symbolic L1 terms) but with a different
+internal architecture, designed for the TPU lowering path:
 
-* :meth:`Constraints.to_GhAb` — the reference's standard-form output
-  ``G x <= h``, ``A x = b`` (``constraints.py:114-167``), kept for API
-  parity and the shape-contract unit tests.
-* :meth:`Constraints.to_canonical` — the TPU-native lowering to a
-  *static-shape* :class:`~porqua_tpu.qp.canonical.CanonicalQP`: rows are
-  padded to a fixed count with +/-inf bounds so a whole backtest of
-  per-date problems stacks into one batched device array.
+every linear constraint is stored as one *interval row*
+``lower <= a . x <= upper`` from the moment it is added. Equalities are
+rows with ``lower == upper``; one-sided inequalities have an infinite
+bound. This is exactly the row form the batched device solver consumes
+(:class:`~porqua_tpu.qp.canonical.CanonicalQP` interval form), so the
+TPU lowering :meth:`Constraints.to_canonical` is a direct stack of the
+stored rows — no sense bookkeeping, no sign flipping at solve time.
+
+The reference's standard form ``G x <= h`` / ``A x = b`` is kept as a
+*view* (:meth:`Constraints.to_GhAb`) for API parity and for the ported
+shape-contract tests; it is derived from the interval rows on demand.
 
 Everything here is pandas/numpy; nothing is traced. This is the host
 side of the host-build / device-solve split.
@@ -20,102 +23,205 @@ side of the host-build / device-solve split.
 
 from __future__ import annotations
 
+import math
 import warnings
-from typing import Dict, Optional
+from dataclasses import dataclass
+from typing import Dict, List, Optional
 
 import numpy as np
 import pandas as pd
 
+_INF = float("inf")
+
 
 def match_arg(x, lst):
-    """First element of ``lst`` containing ``x`` (R-style partial matching,
-    reference ``constraints.py:175``)."""
-    matches = [el for el in lst if x in el]
-    if not matches:
-        raise ValueError(f"{x!r} does not match any of {lst}")
-    return matches[0]
+    """First element of ``lst`` containing ``x`` as a substring (the
+    R-style partial matching the reference DSL exposes)."""
+    for candidate in lst:
+        if x in candidate:
+            return candidate
+    raise ValueError(f"{x!r} does not match any of {lst}")
 
 
 def box_constraint(box_type: str = "LongOnly", lower=None, upper=None) -> dict:
-    """Resolve box-type defaults (reference ``constraints.py:178-204``)."""
-    box_type = match_arg(box_type, ["LongOnly", "LongShort", "Unbounded"])
+    """Resolve box-type defaults into concrete lower/upper values.
 
-    if box_type == "Unbounded":
-        lower = float("-inf") if lower is None else lower
-        upper = float("inf") if upper is None else upper
-    elif box_type == "LongShort":
-        lower = -1 if lower is None else lower
-        upper = 1 if upper is None else upper
-    else:  # LongOnly
-        if lower is None:
-            if upper is None:
-                lower, upper = 0, 1
-            else:
-                lower = upper * 0
-        else:
-            if not np.isscalar(lower) and any(l < 0 for l in lower):
+    Same semantics as the reference helper (``constraints.py:178-204``):
+    Unbounded -> (-inf, inf), LongShort -> (-1, 1), LongOnly -> (0, 1),
+    with caller-supplied values taking precedence and LongOnly rejecting
+    negative lower bounds.
+    """
+    kind = match_arg(box_type, ["LongOnly", "LongShort", "Unbounded"])
+    defaults = {"Unbounded": (-_INF, _INF), "LongShort": (-1, 1),
+                "LongOnly": (0, 1)}
+    dlo, dhi = defaults[kind]
+
+    if kind == "LongOnly":
+        if lower is not None:
+            bad = (lower < 0) if np.isscalar(lower) else any(
+                v < 0 for v in lower)
+            if bad:
                 raise ValueError(
-                    "Inconsistent lower bounds for box_type 'LongOnly'. "
-                    "Change box_type to LongShort or ensure that lower >= 0."
-                )
-            upper = lower * 0 + 1 if upper is None else upper
+                    "LongOnly boxes need nonnegative lower bounds; use "
+                    "box_type='LongShort' to allow short positions.")
+            if upper is None:
+                upper = lower * 0 + 1 if not np.isscalar(lower) else 1
+        elif upper is not None:
+            lower = upper * 0 if not np.isscalar(upper) else 0
 
-    return {"box_type": box_type, "lower": lower, "upper": upper}
+    lower = dlo if lower is None else lower
+    upper = dhi if upper is None else upper
+    return {"box_type": kind, "lower": lower, "upper": upper}
 
 
-def linear_constraint(Amat=None, sense: str = "=", rhs=float("inf"),
+def linear_constraint(Amat=None, sense: str = "=", rhs=_INF,
                       index_or_name=None, a_values=None) -> dict:
-    """Plain-dict linear-constraint record (reference ``constraints.py:206-218``)."""
-    ans = {"Amat": Amat, "sense": sense, "rhs": rhs}
+    """Plain-dict linear-constraint record (reference API parity,
+    ``constraints.py:206-218``)."""
+    out = {"Amat": Amat, "sense": sense, "rhs": rhs}
     if index_or_name is not None:
-        ans["index_or_name"] = index_or_name
+        out["index_or_name"] = index_or_name
     if a_values is not None:
-        ans["a_values"] = a_values
-    return ans
+        out["a_values"] = a_values
+    return out
+
+
+def _interval_from_sense(sense: str, rhs: float):
+    """Map a (sense, rhs) pair onto the interval [lower, upper]."""
+    if sense == "=":
+        return float(rhs), float(rhs)
+    if sense == "<=":
+        return -_INF, float(rhs)
+    if sense == ">=":
+        return float(rhs), _INF
+    raise ValueError(f"unknown constraint sense {sense!r}")
+
+
+@dataclass
+class IntervalRow:
+    """One stored constraint row: ``lower <= coeffs . x <= upper``."""
+
+    coeffs: pd.Series           # aligned to the selection, zeros filled
+    lower: float
+    upper: float
+    name: str = ""
+
+    @property
+    def is_equality(self) -> bool:
+        return self.lower == self.upper
+
+
+@dataclass
+class _Box:
+    """Per-variable bounds; ``kind == 'NA'`` means not configured."""
+
+    kind: str = "NA"
+    lower: Optional[pd.Series] = None
+    upper: Optional[pd.Series] = None
 
 
 class Constraints:
-    """Constraint container for one asset universe (``selection``).
+    """Constraint set for one asset universe.
 
-    API-compatible with the reference class (``constraints.py:23-167``):
-    ``add_budget``, ``add_box``, ``add_linear``, ``add_l1``, ``to_GhAb``.
+    Same builder surface as the reference DSL (``add_budget``,
+    ``add_box``, ``add_linear``, ``add_l1``, ``to_GhAb``) plus the
+    TPU-native lowerings (``interval_rows``, ``bounds``,
+    ``to_canonical``). Internally everything is interval rows — see the
+    module docstring.
     """
 
     def __init__(self, selection="NA") -> None:
-        if not all(isinstance(item, str) for item in selection):
-            raise ValueError("argument 'selection' has to be a character vector.")
+        for item in selection:
+            if not isinstance(item, str):
+                raise ValueError(
+                    "'selection' must be an iterable of asset-name strings")
         self.selection = selection
-        self.budget = {"Amat": None, "sense": None, "rhs": None}
-        self.box = {"box_type": "NA", "lower": None, "upper": None}
-        self.linear = {"Amat": None, "sense": None, "rhs": None}
-        self.l1 = {}
+        self._budget: Optional[IntervalRow] = None
+        self._rows: List[IntervalRow] = []
+        self._box = _Box()
+        self.l1: Dict[str, dict] = {}
 
     def __str__(self) -> str:
-        return " ".join(f"\n{key}:\n\n{vars(self)[key]}\n" for key in vars(self))
+        parts = [f"selection: {list(self.selection)}",
+                 f"budget: {self.budget}", f"box: {self.box}"]
+        parts += [f"row[{r.name}]: {r.lower} <= {dict(r.coeffs)} <= "
+                  f"{r.upper}" for r in self._rows]
+        parts += [f"l1[{k}]: {v}" for k, v in self.l1.items()]
+        return "\n".join(parts)
+
+    # ------------------------------------------------------------------
+    # Reference-compatible dict views
+    # ------------------------------------------------------------------
+
+    @property
+    def budget(self) -> dict:
+        if self._budget is None:
+            return {"Amat": None, "sense": None, "rhs": None}
+        row = self._budget
+        if row.is_equality:
+            sense, rhs = "=", row.upper
+        elif math.isfinite(row.upper):
+            sense, rhs = "<=", row.upper
+        else:
+            sense, rhs = ">=", row.lower
+        return {"Amat": row.coeffs, "sense": sense, "rhs": rhs}
+
+    @property
+    def box(self) -> dict:
+        return {"box_type": self._box.kind, "lower": self._box.lower,
+                "upper": self._box.upper}
+
+    @property
+    def linear(self) -> dict:
+        if not self._rows:
+            return {"Amat": None, "sense": None, "rhs": None}
+        senses, rhs = [], []
+        for r in self._rows:
+            if r.is_equality:
+                senses.append("=")
+                rhs.append(r.upper)
+            elif math.isfinite(r.upper):
+                senses.append("<=")
+                rhs.append(r.upper)
+            else:
+                senses.append(">=")
+                rhs.append(r.lower)
+        Amat = pd.DataFrame([r.coeffs for r in self._rows])
+        Amat.index = [r.name for r in self._rows]
+        return {"Amat": Amat, "sense": pd.Series(senses, index=Amat.index),
+                "rhs": pd.Series(rhs, index=Amat.index)}
 
     # ------------------------------------------------------------------
     # Builders
     # ------------------------------------------------------------------
 
-    def add_budget(self, rhs=1, sense: str = "=") -> None:
-        if self.budget.get("rhs") is not None:
-            warnings.warn("Existing budget constraint is overwritten\n")
-        a_values = pd.Series(np.ones(len(self.selection)), index=self.selection)
-        self.budget = {"Amat": a_values, "sense": sense, "rhs": rhs}
+    def _aligned(self, values) -> pd.Series:
+        """Coerce coefficients to a float Series over the selection."""
+        s = pd.Series(values, dtype=float) if not isinstance(
+            values, pd.Series) else values.astype(float)
+        return s.reindex(list(self.selection)).fillna(0.0)
 
-    def add_box(self, box_type: str = "LongOnly", lower=None, upper=None) -> None:
-        boxcon = box_constraint(box_type, lower, upper)
-        if np.isscalar(boxcon["lower"]):
-            boxcon["lower"] = pd.Series(
-                np.full(len(self.selection), float(boxcon["lower"])), index=self.selection
-            )
-        if np.isscalar(boxcon["upper"]):
-            boxcon["upper"] = pd.Series(
-                np.full(len(self.selection), float(boxcon["upper"])), index=self.selection
-            )
-        if (boxcon["upper"] < boxcon["lower"]).any():
-            raise ValueError("Some lower bounds are higher than the corresponding upper bounds.")
-        self.box = boxcon
+    def add_budget(self, rhs=1, sense: str = "=") -> None:
+        if self._budget is not None:
+            warnings.warn("replacing the existing budget constraint")
+        ones = pd.Series(1.0, index=list(self.selection))
+        lo, hi = _interval_from_sense(sense, rhs)
+        self._budget = IntervalRow(ones, lo, hi, name="budget")
+
+    def add_box(self, box_type: str = "LongOnly", lower=None,
+                upper=None) -> None:
+        spec = box_constraint(box_type, lower, upper)
+        idx = list(self.selection)
+        lb = spec["lower"]
+        ub = spec["upper"]
+        lb = pd.Series(float(lb), index=idx) if np.isscalar(lb) \
+            else pd.Series(lb, index=idx, dtype=float)
+        ub = pd.Series(float(ub), index=idx) if np.isscalar(ub) \
+            else pd.Series(ub, index=idx, dtype=float)
+        if (ub < lb).any():
+            raise ValueError(
+                "box upper bounds must not be below the lower bounds")
+        self._box = _Box(spec["box_type"], lb, ub)
 
     def add_linear(self,
                    Amat: Optional[pd.DataFrame] = None,
@@ -123,105 +229,116 @@ class Constraints:
                    sense="=",
                    rhs=None,
                    name: Optional[str] = None) -> None:
+        """Append one or more rows. ``Amat`` is a (rows x assets) frame;
+        alternatively a single row via ``a_values``. ``sense``/``rhs``
+        may be scalars (broadcast) or Series aligned to the rows."""
         if Amat is None:
             if a_values is None:
-                raise ValueError("Either 'Amat' or 'a_values' must be provided.")
-            Amat = pd.DataFrame(a_values).T.reindex(columns=self.selection).fillna(0)
-            if name is not None:
-                Amat.index = [name]
+                raise ValueError("provide 'Amat' or 'a_values'")
+            Amat = pd.DataFrame(
+                [self._aligned(a_values)],
+                index=[name if name is not None else len(self._rows)])
 
-        if isinstance(sense, str):
-            sense = pd.Series([sense])
-        if isinstance(rhs, (int, float)):
-            rhs = pd.Series([rhs])
-
-        if self.linear["Amat"] is not None:
-            Amat = pd.concat([self.linear["Amat"], Amat], axis=0, ignore_index=False)
-            sense = pd.concat([self.linear["sense"], sense], axis=0, ignore_index=False)
-            rhs = pd.concat([self.linear["rhs"], rhs], axis=0, ignore_index=False)
-
-        Amat = Amat.fillna(0)
-        self.linear = {"Amat": Amat, "sense": sense, "rhs": rhs}
+        n_rows = Amat.shape[0]
+        senses = list(sense) if not isinstance(sense, str) else [sense] * n_rows
+        rhss = [rhs] * n_rows if np.isscalar(rhs) or rhs is None else list(rhs)
+        for i in range(n_rows):
+            lo, hi = _interval_from_sense(senses[i], rhss[i])
+            self._rows.append(IntervalRow(
+                self._aligned(Amat.iloc[i]), lo, hi, name=str(Amat.index[i])))
 
     def add_l1(self, name: str, rhs=None, x0=None, *args, **kwargs) -> None:
-        """Record an L1 constraint symbolically (turnover / leverage).
+        """Record an L1 term symbolically (turnover / leverage).
 
-        Mirror of reference ``constraints.py:97-112``. The TPU solve path
-        consumes these either through static-shape linearization
-        (:mod:`porqua_tpu.qp.lift`) or as prox terms in the ADMM solver.
+        The solve path consumes these either via static-shape
+        linearization (:mod:`porqua_tpu.qp.lift`) or as prox terms in
+        the ADMM solver — never as expanded rows here, so shapes stay
+        static across a backtest.
         """
         if rhs is None:
-            raise TypeError("argument 'rhs' is required.")
-        con = {"rhs": rhs}
+            raise TypeError("add_l1 needs an 'rhs' budget value")
+        record = dict(kwargs)
+        record["rhs"] = rhs
         if x0:
-            con["x0"] = x0
-        for i, arg in enumerate(args):
-            con[f"arg{i}"] = arg
-        con.update(kwargs)
-        self.l1[name] = con
+            record["x0"] = x0
+        for i, extra in enumerate(args):
+            record[f"arg{i}"] = extra
+        self.l1[name] = record
 
     # ------------------------------------------------------------------
     # Lowerings
     # ------------------------------------------------------------------
 
+    def _ordered_rows(self) -> List[IntervalRow]:
+        """Budget first, then user rows in insertion order."""
+        rows = [self._budget] if self._budget is not None else []
+        return rows + self._rows
+
+    def interval_rows(self):
+        """Stack all rows as ``(C, l, u)`` numpy arrays, equalities
+        first (then inequalities), preserving insertion order within
+        each group. This is the direct input to the device solver."""
+        n = len(self.selection)
+        rows = self._ordered_rows()
+        eq = [r for r in rows if r.is_equality]
+        ineq = [r for r in rows if not r.is_equality]
+        ordered = eq + ineq
+        if not ordered:
+            return (np.zeros((0, n)), np.zeros((0,)), np.zeros((0,)))
+        C = np.stack([r.coeffs.to_numpy() for r in ordered])
+        l = np.array([r.lower for r in ordered])
+        u = np.array([r.upper for r in ordered])
+        return C, l, u
+
+    def bounds(self):
+        """Per-variable ``(lb, ub)`` numpy arrays (±inf when no box)."""
+        n = len(self.selection)
+        if self._box.kind == "NA":
+            return np.full(n, -_INF), np.full(n, _INF)
+        return (self._box.lower.to_numpy(dtype=float),
+                self._box.upper.to_numpy(dtype=float))
+
     def to_GhAb(self, lbub_to_G: bool = False) -> Dict[str, Optional[np.ndarray]]:
-        """Standard form ``{'G','h','A','b'}`` with all inequalities as ``<=``.
+        """Standard-form view ``{'G','h','A','b'}``: equality rows in
+        ``A x = b``, everything else as ``G x <= h`` (lower-bounded rows
+        negated). Row order matches the reference contract: budget, then
+        (optionally) box rows as ``[-I; I]``, then user rows."""
+        n = len(self.selection)
+        A_rows, b_vals, G_rows, h_vals = [], [], [], []
 
-        Reference-parity output (``constraints.py:114-167``) including the
-        row ordering: budget first, then (optionally) box-as-G rows, then
-        user linear rows split into equalities and inequalities with
-        ``>=`` rows sign-flipped.
-        """
-        A = b = G = h = None
-
-        if self.budget["Amat"] is not None:
-            if self.budget["sense"] == "=":
-                A = np.asarray(self.budget["Amat"], dtype=float)
-                b = np.array(self.budget["rhs"], dtype=float)
+        def lower_one(row: IntervalRow):
+            a = row.coeffs.to_numpy()
+            if row.is_equality:
+                A_rows.append(a)
+                b_vals.append(row.upper)
+            elif math.isfinite(row.upper):
+                G_rows.append(a)
+                h_vals.append(row.upper)
             else:
-                G = np.asarray(self.budget["Amat"], dtype=float)
-                h = np.array(self.budget["rhs"], dtype=float)
+                G_rows.append(-a)
+                h_vals.append(-row.lower)
 
+        if self._budget is not None:
+            lower_one(self._budget)
         if lbub_to_G:
-            eye = np.eye(len(self.selection))
-            G_tmp = np.concatenate((-eye, eye), axis=0)
-            h_tmp = np.concatenate(
-                (-np.asarray(self.box["lower"], dtype=float),
-                 np.asarray(self.box["upper"], dtype=float))
-            )
-            G = np.vstack((G, G_tmp)) if G is not None else G_tmp
-            h = np.concatenate((h, h_tmp), axis=None) if h is not None else h_tmp
+            lb, ub = self.bounds()
+            eye = np.eye(n)
+            G_rows.extend(-eye)
+            h_vals.extend(-lb)
+            G_rows.extend(eye)
+            h_vals.extend(ub)
+        for row in self._rows:
+            lower_one(row)
 
-        if self.linear["Amat"] is not None:
-            Amat = self.linear["Amat"].copy()
-            rhs = self.linear["rhs"].copy()
-
-            idx_geq = np.asarray(self.linear["sense"] == ">=")
-            if idx_geq.sum() > 0:
-                Amat[idx_geq] = -Amat[idx_geq]
-                rhs[idx_geq] = -rhs[idx_geq]
-
-            G_tmp = h_tmp = None
-            idx_eq = np.asarray(self.linear["sense"] == "=")
-            if idx_eq.sum() > 0:
-                A_tmp = Amat[idx_eq].to_numpy()
-                b_tmp = rhs[idx_eq].to_numpy()
-                A = np.vstack((A, A_tmp)) if A is not None else A_tmp
-                b = np.concatenate((b, b_tmp), axis=None) if b is not None else b_tmp
-                if idx_eq.sum() < Amat.shape[0]:
-                    G_tmp = Amat[~idx_eq].to_numpy()
-                    h_tmp = rhs[~idx_eq].to_numpy()
-            else:
-                G_tmp = Amat.to_numpy()
-                h_tmp = rhs.to_numpy()
-
-            if G_tmp is not None:
-                G = np.vstack((G, G_tmp)) if G is not None else G_tmp
-                h = np.concatenate((h, h_tmp), axis=None) if h is not None else h_tmp
-
-        A = A.reshape(-1, A.shape[-1]) if A is not None else None
-        G = G.reshape(-1, G.shape[-1]) if G is not None else None
-        return {"G": G, "h": h, "A": A, "b": b}
+        out: Dict[str, Optional[np.ndarray]] = {
+            "G": None, "h": None, "A": None, "b": None}
+        if A_rows:
+            out["A"] = np.stack(A_rows).reshape(-1, n)
+            out["b"] = np.asarray(b_vals, dtype=float)
+        if G_rows:
+            out["G"] = np.stack(G_rows).reshape(-1, n)
+            out["h"] = np.asarray(h_vals, dtype=float)
+        return out
 
     def to_canonical(self,
                      P: Optional[np.ndarray] = None,
@@ -229,48 +346,22 @@ class Constraints:
                      constant: float = 0.0,
                      n_max: Optional[int] = None,
                      m_max: Optional[int] = None):
-        """Lower constraints (+ optional objective) to a padded CanonicalQP.
+        """Lower constraints (+ optional objective) to a padded
+        :class:`~porqua_tpu.qp.canonical.CanonicalQP`.
 
-        All row types collapse into interval form ``l <= Cx <= u`` (eq
-        rows have ``l == u``); the box becomes per-variable ``lb/ub``.
-        Rows are padded to ``m_max`` and variables to ``n_max`` so that
-        per-date problems of differing active-universe size batch into
-        one array. See :class:`porqua_tpu.qp.canonical.CanonicalQP`.
+        A direct stack of the stored interval rows: no sense handling
+        happens here because none was stored. Rows are padded to
+        ``m_max`` and variables to ``n_max`` so per-date problems of
+        differing universe size batch into one device array.
         """
         from porqua_tpu.qp.canonical import CanonicalQP
 
         n = len(self.selection)
-        GhAb = self.to_GhAb()
-
-        rows, lo, hi = [], [], []
-        if GhAb["A"] is not None:
-            rows.append(GhAb["A"])
-            lo.append(np.atleast_1d(GhAb["b"]))
-            hi.append(np.atleast_1d(GhAb["b"]))
-        if GhAb["G"] is not None:
-            rows.append(GhAb["G"])
-            lo.append(np.full(GhAb["G"].shape[0], -np.inf))
-            hi.append(np.atleast_1d(GhAb["h"]))
-
-        C = np.concatenate(rows, axis=0) if rows else np.zeros((0, n))
-        l = np.concatenate(lo) if lo else np.zeros((0,))
-        u = np.concatenate(hi) if hi else np.zeros((0,))
-
-        if self.box["box_type"] != "NA":
-            lb = np.asarray(self.box["lower"], dtype=float)
-            ub = np.asarray(self.box["upper"], dtype=float)
-        else:
-            lb = np.full(n, -np.inf)
-            ub = np.full(n, np.inf)
-
-        if P is None:
-            P = np.zeros((n, n))
-        if q is None:
-            q = np.zeros(n)
-
+        C, l, u = self.interval_rows()
+        lb, ub = self.bounds()
         return CanonicalQP.build(
-            P=np.asarray(P, dtype=float),
-            q=np.asarray(q, dtype=float),
+            P=np.zeros((n, n)) if P is None else np.asarray(P, dtype=float),
+            q=np.zeros(n) if q is None else np.asarray(q, dtype=float),
             C=C, l=l, u=u, lb=lb, ub=ub,
             constant=float(constant),
             n_max=n_max, m_max=m_max,

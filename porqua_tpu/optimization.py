@@ -136,27 +136,8 @@ class Optimization(ABC):
 
         constraints = self.constraints
         n = len(constraints.selection)
-        GhAb = constraints.to_GhAb()
-
-        rows, lo, hi = [], [], []
-        if GhAb["A"] is not None:
-            rows.append(GhAb["A"])
-            lo.append(np.atleast_1d(GhAb["b"]))
-            hi.append(np.atleast_1d(GhAb["b"]))
-        if GhAb["G"] is not None:
-            rows.append(GhAb["G"])
-            lo.append(np.full(GhAb["G"].shape[0], -np.inf))
-            hi.append(np.atleast_1d(GhAb["h"]))
-        C = np.concatenate(rows, axis=0) if rows else np.zeros((0, n))
-        l = np.concatenate(lo) if lo else np.zeros((0,))
-        u = np.concatenate(hi) if hi else np.zeros((0,))
-
-        if constraints.box["box_type"] != "NA":
-            lb = np.asarray(constraints.box["lower"], dtype=float)
-            ub = np.asarray(constraints.box["upper"], dtype=float)
-        else:
-            lb = np.full(n, -np.inf)
-            ub = np.full(n, np.inf)
+        C, l, u = constraints.interval_rows()
+        lb, ub = constraints.bounds()
 
         parts = lift._as_parts(np.asarray(P, float), np.asarray(q, float), C, l, u, lb, ub)
 
@@ -382,37 +363,21 @@ class LAD(Optimization):
     def canonical_parts(self) -> dict:
         X = to_numpy(self.objective["X"])
         y = to_numpy(self.objective["y"]).reshape(-1)
-        GhAb = self.constraints.to_GhAb()
         N = X.shape[1]
         T = X.shape[0]
         dim = N + 2 * T
 
-        rows, lo, hi = [], [], []
-        if GhAb["A"] is not None:
-            A = np.pad(GhAb["A"], [(0, 0), (0, 2 * T)])
-            rows.append(A)
-            lo.append(np.atleast_1d(GhAb["b"]))
-            hi.append(np.atleast_1d(GhAb["b"]))
-        # Residual-splitting equalities: X w + e+ - e- = y
+        # Constraint rows on w, widened with zero columns for the
+        # residual-splitting aux block, then the T equality rows
+        # X w + e+ - e- = y.
+        Cw, lw, uw = self.constraints.interval_rows()
         resid = np.concatenate([X, np.eye(T), -np.eye(T)], axis=1)
-        rows.append(resid)
-        lo.append(y)
-        hi.append(y)
-        if GhAb["G"] is not None:
-            G = np.pad(GhAb["G"], [(0, 0), (0, 2 * T)])
-            rows.append(G)
-            lo.append(np.full(G.shape[0], -np.inf))
-            hi.append(np.atleast_1d(GhAb["h"]))
-        C = np.concatenate(rows, axis=0)
-        l = np.concatenate(lo)
-        u = np.concatenate(hi)
+        C = np.concatenate(
+            [np.pad(Cw, [(0, 0), (0, 2 * T)]), resid], axis=0)
+        l = np.concatenate([lw, y])
+        u = np.concatenate([uw, y])
 
-        if self.constraints.box["box_type"] != "NA":
-            lb_w = to_numpy(self.constraints.box["lower"])
-            ub_w = to_numpy(self.constraints.box["upper"])
-        else:
-            lb_w = np.full(N, -np.inf)
-            ub_w = np.full(N, np.inf)
+        lb_w, ub_w = self.constraints.bounds()
         lb = np.concatenate([lb_w, np.zeros(2 * T)])
         ub = np.concatenate([ub_w, np.full(2 * T, np.inf)])
 
@@ -452,57 +417,62 @@ class PercentilePortfolios(Optimization):
                  **kwargs):
         super().__init__(**kwargs)
         self.estimator = estimator
-        self.params = OptimizationParameter(
-            solver_name="percentile",
-            n_percentiles=n_percentiles,
-            field=field,
-        )
+        self.params.update(solver_name="percentile",
+                           n_percentiles=n_percentiles, field=field)
 
-    def set_objective(self, optimization_data: OptimizationData) -> None:
+    def _score_series(self, optimization_data: OptimizationData) -> pd.Series:
+        """Resolve the ranking signal: an estimator over returns, a
+        named column of the scores frame, a weighted column blend, or
+        the plain cross-column mean — in that precedence order."""
         field = self.params.get("field")
         if self.estimator is not None:
             if field is not None:
-                raise ValueError('Either specify a "field" or pass an "estimator", but not both.')
-            scores = self.estimator.estimate(X=optimization_data["return_series"])
-        else:
-            if field is not None:
-                scores = optimization_data["scores"][field]
-            else:
-                score_weights = self.params.get("score_weights")
-                if score_weights is not None:
-                    scores = (
-                        optimization_data["scores"][score_weights.keys()]
-                        .multiply(score_weights.values())
-                        .sum(axis=1)
-                    )
-                else:
-                    scores = optimization_data["scores"].mean(axis=1).squeeze()
+                raise ValueError(
+                    "'field' and 'estimator' are mutually exclusive")
+            return self.estimator.estimate(
+                X=optimization_data["return_series"])
+        frame = optimization_data["scores"]
+        if field is not None:
+            return frame[field]
+        blend = self.params.get("score_weights")
+        if blend is not None:
+            cols = frame[list(blend.keys())]
+            return (cols * pd.Series(blend)).sum(axis=1)
+        return frame.mean(axis=1).squeeze()
 
-        # Deterministic tiny noise on zero scores (the reference uses
-        # np.random at optimization.py:393; an explicit keyed RNG keeps
-        # runs reproducible).
-        n_zero = int((scores == 0).sum())
-        if n_zero > 0:
-            seed = int(self.params.get("seed", 0))
-            rng = np.random.default_rng(seed)
-            scores[scores == 0] = rng.normal(0, 1e-10, n_zero)
+    def set_objective(self, optimization_data: OptimizationData) -> None:
+        scores = self._score_series(optimization_data)
+        # Zero scores would create duplicate percentile thresholds; add
+        # deterministic sub-numerical jitter (the reference draws from
+        # the global np.random state at optimization.py:393 — a seeded
+        # generator keeps runs reproducible).
+        zeros = scores == 0
+        if zeros.any():
+            rng = np.random.default_rng(int(self.params.get("seed", 0)))
+            scores = scores.copy()
+            scores[zeros] = rng.normal(0.0, 1e-10, int(zeros.sum()))
         self.objective = Objective(scores=-scores)
 
     def solve(self) -> bool:
         scores = self.objective["scores"]
         N = self.params["n_percentiles"]
-        q_vec = np.linspace(0, 100, N + 1)
-        th = np.percentile(scores, q_vec)
-        lID = []
+        th = np.percentile(scores, np.linspace(0, 100, N + 1))
+
+        # Vectorized bucket assignment: bucket b covers
+        # th[b-1] < s <= th[b], with the lowest bucket closed below.
+        vals = scores.to_numpy()
+        buckets = np.minimum(
+            np.searchsorted(th[1:], vals, side="left") + 1, N)
+
         w_dict = {}
-        for i in range(1, len(th)):
-            if i == 1:
-                lID.append(list(scores.index[scores <= th[i]]))
-            else:
-                lID.append(list(scores.index[np.logical_and(scores > th[i - 1], scores <= th[i])]))
-            w_dict[i] = scores[lID[i - 1]] * 0 + 1 / len(lID[i - 1])
-        weights = scores * 0
-        weights[w_dict[1].keys()] = 1 / len(w_dict[1].keys())
-        weights[w_dict[N].keys()] = -1 / len(w_dict[N].keys())
+        for b in range(1, N + 1):
+            members = scores.index[buckets == b]
+            w_dict[b] = pd.Series(1.0 / max(len(members), 1), index=members)
+
+        # Negated scores: bucket 1 holds the highest raw scores (long),
+        # bucket N the lowest (short); everything between stays flat.
+        weights = pd.Series(0.0, index=scores.index)
+        weights[w_dict[1].index] = 1.0 / max(len(w_dict[1]), 1)
+        weights[w_dict[N].index] = -1.0 / max(len(w_dict[N]), 1)
         self.results = {"weights": weights.to_dict(), "w_dict": w_dict}
         return True

@@ -1,60 +1,73 @@
 """Named time-series container for one optimization problem.
 
-Host-side mirror of reference ``src/optimization_data.py``: a dict of
-aligned pandas series/frames (return_series, bm_series, scores, ...)
-with optional per-key lags and date alignment by index intersection.
-Also adds the ``train_test_split`` used by the reference's ml notebook
-(called at ``example/ml.ipynb`` cell 4 but missing from the reference
-snapshot — stale API we restore here).
+Same capability as the reference's data container
+(``/root/reference/src/optimization_data.py``: named series with
+per-key lags and date alignment) with a different implementation:
+alignment is one inner-join over the collected indexes rather than a
+stateful loop, and a chronological ``train_test_split`` is provided
+(the reference's ml notebook calls it at ``example/ml.ipynb`` cell 4
+but the method is missing from that snapshot).
+
+Host-side only; the batched device backtest consumes the aligned
+windows as padded arrays.
 """
 
 from __future__ import annotations
 
+from functools import reduce
 from typing import Optional
 
 import pandas as pd
 
 
 class OptimizationData(dict):
+    """Dict of named pandas series/frames sharing one date index.
+
+    Keys double as attributes for reads (``od.return_series`` ==
+    ``od['return_series']``), matching the reference container's
+    notebook-facing ergonomics."""
+
+    def __getattr__(self, name):
+        try:
+            return self[name]
+        except KeyError:
+            raise AttributeError(name) from None
 
     def __init__(self, align=True, lags={}, *args, **kwargs):
         super().__init__(*args, **kwargs)
-        self.__dict__ = self
-        if len(lags) > 0:
-            for key in lags.keys():
-                self[key] = self[key].shift(lags[key])
-        if align and len(self) > 0:
+        for key, lag in lags.items():
+            self[key] = self[key].shift(lag)
+        if align and self:
             self.align_dates()
 
-    def align_dates(self, variable_names: Optional[list] = None, dropna: bool = True) -> None:
-        if variable_names is None:
-            variable_names = list(self.keys())
-        index = self.intersecting_dates(variable_names=list(variable_names), dropna=dropna)
-        for key in variable_names:
-            self[key] = self[key].loc[index]
+    def align_dates(self, variable_names: Optional[list] = None,
+                    dropna: bool = True) -> None:
+        """Restrict the named series (default: all) to their common
+        dates, optionally dropping NaN rows first."""
+        names = list(self.keys()) if variable_names is None \
+            else list(variable_names)
+        common = self.intersecting_dates(names, dropna=dropna)
+        self.update({k: self[k].loc[common] for k in names})
 
     def intersecting_dates(self,
                            variable_names: Optional[list] = None,
                            dropna: bool = True) -> pd.Index:
-        if variable_names is None:
-            variable_names = list(self.keys())
+        names = list(self.keys()) if variable_names is None \
+            else list(variable_names)
         if dropna:
-            for variable_name in variable_names:
-                self[variable_name] = self[variable_name].dropna()
-        index = self.get(variable_names[0]).index
-        for variable_name in variable_names:
-            index = index.intersection(self.get(variable_name).index)
-        return index
+            for k in names:
+                self[k] = self[k].dropna()
+        return reduce(lambda idx, k: idx.intersection(self[k].index),
+                      names[1:], self[names[0]].index)
 
-    def train_test_split(self, test_size: float = 0.2, keys: Optional[list] = None):
+    def train_test_split(self, test_size: float = 0.2,
+                         keys: Optional[list] = None):
         """Chronological train/test split of every (or selected) series."""
-        if keys is None:
-            keys = list(self.keys())
-        first = self[keys[0]]
-        cut = int(round(len(first.index) * (1.0 - test_size)))
-        train = {k: self[k].iloc[:cut] for k in keys}
-        test = {k: self[k].iloc[cut:] for k in keys}
+        keys = list(self.keys()) if keys is None else keys
+        cut = int(round(len(self[keys[0]].index) * (1.0 - test_size)))
         return (
-            OptimizationData(align=False, **train),
-            OptimizationData(align=False, **test),
+            OptimizationData(
+                align=False, **{k: self[k].iloc[:cut] for k in keys}),
+            OptimizationData(
+                align=False, **{k: self[k].iloc[cut:] for k in keys}),
         )

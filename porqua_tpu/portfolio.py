@@ -1,301 +1,289 @@
-"""Portfolio & strategy accounting (mirror of reference ``src/portfolio.py``).
+"""Portfolio & strategy accounting (host-side pandas engine).
 
-Host-side API parity: ``Portfolio`` (rebalancing date + weights dict),
-``Strategy`` (list of portfolios, turnover, simulate), and the
-``floating_weights`` drift helper. The device-side vectorized return
-engine — the whole simulation as one XLA program over (dates x assets)
-— lives in :mod:`porqua_tpu.accounting`; ``Strategy.simulate`` here
-keeps the reference's pandas semantics and is the golden reference the
-vectorized engine is tested against.
+Covers the reference's accounting layer capabilities
+(``/root/reference/src/portfolio.py``: dated weight snapshots, drifted
+weights, turnover, cost-aware return simulation) with a different
+architecture: weights are held as aligned numpy/Series data, drift is a
+single vectorized cumulative-product per holding period, and the sleeve
+(margin/cash/loan) arithmetic happens on the summed level directly
+instead of widening the weight frame with synthetic columns.
+
+Two known reference defects are deliberately not reproduced (SURVEY.md
+section 2): turnover compares the drifted *old* portfolio against the
+*new* weights in both branches, and the first rebalance books the full
+initial acquisition as trading volume.
+
+The device-vectorized simulation — whole backtest as one XLA program —
+lives in :mod:`porqua_tpu.accounting`; this module is the independent
+behavioral model it is tested against.
 """
 
 from __future__ import annotations
+
+import bisect
+from typing import Optional
 
 import numpy as np
 import pandas as pd
 
 
+def floating_weights(X: pd.DataFrame, w, start_date, end_date,
+                     rescale: bool = True) -> pd.DataFrame:
+    """Drift weights ``w`` by cumulative asset returns.
+
+    Row 0 (at ``start_date``) holds ``w`` itself; each later row is the
+    previous row compounded by that day's returns. With ``rescale``,
+    every row is renormalized so the long and short sides each sum to
+    +/-1 of their own gross (the reference's long/short renormalization,
+    ``portfolio.py:283-286``).
+    """
+    start = pd.to_datetime(start_date)
+    end = pd.to_datetime(end_date)
+    if start < X.index[0] or end > X.index[-1]:
+        raise ValueError(
+            f"the window [{start_date}, {end_date}] must lie inside the "
+            f"return series range [{X.index[0]}, {X.index[-1]}]")
+
+    w = pd.Series(w, dtype=float)
+    if w.isna().any():
+        raise ValueError("weights contain NaN")
+    unknown = w.index.difference(X.columns)
+    if len(unknown):
+        raise ValueError(f"assets missing from the return series: "
+                         f"{list(unknown[:5])}")
+
+    window = X.loc[start:end, w.index]
+    growth = 1.0 + np.nan_to_num(window.to_numpy(dtype=float))
+    growth[0] = w.to_numpy()
+    drift = np.cumprod(growth, axis=0)
+
+    if rescale:
+        longs = np.where(drift >= 0, drift, 0.0)
+        shorts = drift - longs
+        long_gross = longs.sum(axis=1, keepdims=True)
+        short_gross = np.abs(shorts).sum(axis=1, keepdims=True)
+        drift = (np.divide(longs, long_gross,
+                           out=np.zeros_like(longs),
+                           where=long_gross != 0)
+                 + np.divide(shorts, short_gross,
+                             out=np.zeros_like(shorts),
+                             where=short_gross != 0))
+
+    return pd.DataFrame(drift, index=window.index, columns=w.index)
+
+
 class Portfolio:
+    """One dated weight snapshot."""
 
     def __init__(self,
-                 rebalancing_date: str = None,
-                 weights: dict = {},
-                 name: str = None,
-                 init_weights: dict = {}):
+                 rebalancing_date: Optional[str] = None,
+                 weights: Optional[dict] = None,
+                 name: Optional[str] = None,
+                 init_weights: Optional[dict] = None):
+        if rebalancing_date is not None and not isinstance(
+                rebalancing_date, str):
+            raise TypeError("rebalancing_date must be a string (or None)")
+        if name is not None and not isinstance(name, str):
+            raise TypeError("name must be a string (or None)")
         self.rebalancing_date = rebalancing_date
-        self.weights = weights
+        self._w = self._coerce(weights)
         self.name = name
-        self.init_weights = init_weights
+        self.init_weights = dict(init_weights) if init_weights else {}
+        self._initial_cache: dict = {}
+
+    @staticmethod
+    def _coerce(weights) -> pd.Series:
+        if weights is None:
+            return pd.Series(dtype=float)
+        if isinstance(weights, pd.Series):
+            return weights.astype(float)
+        if isinstance(weights, dict):
+            return pd.Series(weights, dtype=float)
+        if hasattr(weights, "to_dict"):
+            return pd.Series(weights.to_dict(), dtype=float)
+        raise TypeError("weights must be dict-like")
 
     @staticmethod
     def empty() -> "Portfolio":
         return Portfolio()
 
     @property
-    def weights(self):
-        return self._weights
+    def weights(self) -> dict:
+        return self._w.to_dict()
 
     @weights.setter
-    def weights(self, new_weights: dict):
-        if not isinstance(new_weights, dict):
-            if hasattr(new_weights, "to_dict"):
-                new_weights = new_weights.to_dict()
-            else:
-                raise TypeError("weights must be a dictionary")
-        self._weights = new_weights
+    def weights(self, value) -> None:
+        self._w = self._coerce(value)
+        self._initial_cache = {}
 
     def get_weights_series(self) -> pd.Series:
-        return pd.Series(self._weights)
-
-    @property
-    def rebalancing_date(self):
-        return self._rebalancing_date
-
-    @rebalancing_date.setter
-    def rebalancing_date(self, new_date: str):
-        if new_date and not isinstance(new_date, str):
-            raise TypeError("date must be a string")
-        self._rebalancing_date = new_date
-
-    @property
-    def name(self):
-        return self._name
-
-    @name.setter
-    def name(self, new_name: str):
-        if new_name is not None and not isinstance(new_name, str):
-            raise TypeError("name must be a string")
-        self._name = new_name
+        return self._w.copy()
 
     def __repr__(self):
-        return f"Portfolio(rebalancing_date={self.rebalancing_date}, weights={self.weights})"
+        return (f"Portfolio({self.rebalancing_date!r}, "
+                f"{len(self._w)} assets)")
 
-    def float_weights(self, return_series: pd.DataFrame, end_date: str, rescale: bool = False):
-        if self.weights is not None:
-            return floating_weights(
-                X=return_series,
-                w=self.weights,
-                start_date=self.rebalancing_date,
-                end_date=end_date,
-                rescale=rescale,
-            )
-        return None
+    def float_weights(self, return_series: pd.DataFrame, end_date: str,
+                      rescale: bool = False):
+        if self._w.empty:
+            return None
+        return floating_weights(
+            return_series, self._w, self.rebalancing_date, end_date,
+            rescale=rescale)
 
     def initial_weights(self,
                         selection,
                         return_series: pd.DataFrame,
                         end_date: str,
-                        rescale: bool = True):
-        if not hasattr(self, "_initial_weights"):
-            if self.rebalancing_date is not None and self.weights is not None:
-                w_init = dict.fromkeys(selection, 0)
-                w_float = self.float_weights(
-                    return_series=return_series, end_date=end_date, rescale=rescale
-                )
-                w_floated = w_float.iloc[-1]
-                w_init.update({key: w_floated[key] for key in w_init.keys() & w_floated.keys()})
-                self._initial_weights = w_init
-            else:
-                self._initial_weights = None
-        return self._initial_weights
+                        rescale: bool = True) -> Optional[dict]:
+        """This portfolio's weights drifted to ``end_date``, expressed
+        over ``selection`` (zeros for ids we never held). Memoized per
+        (selection, end_date, rescale) argument combination."""
+        if self.rebalancing_date is None or self._w.empty:
+            return None
+        key = (tuple(selection), end_date, rescale)
+        if key not in self._initial_cache:
+            drifted = self.float_weights(
+                return_series, end_date, rescale=rescale).iloc[-1]
+            out = pd.Series(0.0, index=list(selection))
+            held = out.index.intersection(drifted.index)
+            out[held] = drifted[held]
+            self._initial_cache[key] = out.to_dict()
+        return self._initial_cache[key]
 
-    def turnover(self, portfolio: "Portfolio", return_series: pd.DataFrame, rescale=True):
-        """Two-sided turnover: drifted old weights vs the newly decided ones.
-
-        The reference's older-portfolio branch subtracts the *old*
-        weights from their own drifted values (reference
-        ``portfolio.py:109-121``), i.e. measures drift rather than
-        trading — inconsistent with its other branch. Both branches here
-        compare the drifted old portfolio against the *newer* portfolio's
-        weights (SURVEY.md section 2, quirks-to-fix list).
-        """
-        if portfolio.rebalancing_date is not None and portfolio.rebalancing_date < self.rebalancing_date:
-            w_init = portfolio.initial_weights(
-                selection=self.weights.keys(),
-                return_series=return_series,
-                end_date=self.rebalancing_date,
-                rescale=rescale,
-            )
-            new_weights = self.weights
-        else:
-            w_init = self.initial_weights(
-                selection=portfolio.weights.keys(),
-                return_series=return_series,
-                end_date=portfolio.rebalancing_date,
-                rescale=rescale,
-            )
-            new_weights = portfolio.weights
-        return pd.Series(w_init).sub(pd.Series(new_weights), fill_value=0).abs().sum()
+    def turnover(self, portfolio: "Portfolio", return_series: pd.DataFrame,
+                 rescale: bool = True) -> float:
+        """L1 distance between the older portfolio drifted to the newer
+        rebalance date and the newer portfolio's fresh weights."""
+        mine = self.rebalancing_date
+        theirs = portfolio.rebalancing_date
+        older, newer = ((portfolio, self)
+                        if theirs is not None and theirs < mine
+                        else (self, portfolio))
+        drifted = older.initial_weights(
+            selection=list(newer._w.index),
+            return_series=return_series,
+            end_date=newer.rebalancing_date,
+            rescale=rescale)
+        diff = pd.Series(drifted).sub(newer._w, fill_value=0.0)
+        return float(diff.abs().sum())
 
 
 class Strategy:
+    """An ordered collection of dated portfolios."""
 
     def __init__(self, portfolios: list):
+        if not isinstance(portfolios, list) or any(
+                not isinstance(p, Portfolio) for p in portfolios):
+            raise TypeError("Strategy takes a list of Portfolio objects")
         self.portfolios = portfolios
 
-    @property
-    def portfolios(self):
-        return self._portfolios
-
-    @portfolios.setter
-    def portfolios(self, new_portfolios: list):
-        if not isinstance(new_portfolios, list):
-            raise TypeError("portfolios must be a list")
-        if not all(isinstance(p, Portfolio) for p in new_portfolios):
-            raise TypeError("all elements in portfolios must be of type Portfolio")
-        self._portfolios = new_portfolios
+    def __repr__(self):
+        return f"Strategy({len(self.portfolios)} portfolios)"
 
     def clear(self) -> None:
         self.portfolios.clear()
 
-    def get_rebalancing_dates(self):
-        return [portfolio.rebalancing_date for portfolio in self.portfolios]
+    def get_rebalancing_dates(self) -> list:
+        return [p.rebalancing_date for p in self.portfolios]
 
-    def get_weights(self, rebalancing_date: str):
-        for portfolio in self.portfolios:
-            if portfolio.rebalancing_date == rebalancing_date:
-                return portfolio.weights
+    def get_portfolio(self, rebalancing_date: str) -> Portfolio:
+        for p in self.portfolios:
+            if p.rebalancing_date == rebalancing_date:
+                return p
+        raise ValueError(
+            f"no portfolio is dated {rebalancing_date!r}")
+
+    def get_weights(self, rebalancing_date: str) -> Optional[dict]:
+        for p in self.portfolios:
+            if p.rebalancing_date == rebalancing_date:
+                return p.weights
         return None
 
     def get_weights_df(self) -> pd.DataFrame:
-        weights_dict = {p.rebalancing_date: p.weights for p in self.portfolios}
-        return pd.DataFrame(weights_dict).T
-
-    def get_portfolio(self, rebalancing_date: str) -> Portfolio:
-        if rebalancing_date in self.get_rebalancing_dates():
-            idx = self.get_rebalancing_dates().index(rebalancing_date)
-            return self.portfolios[idx]
-        raise ValueError(f"No portfolio found for rebalancing date {rebalancing_date}")
+        """(dates x assets) weight matrix, NaN where an asset was not
+        in that date's universe."""
+        return pd.DataFrame.from_dict(
+            {p.rebalancing_date: p.weights for p in self.portfolios},
+            orient="index")
 
     def has_previous_portfolio(self, rebalancing_date: str) -> bool:
         dates = self.get_rebalancing_dates()
-        return len(dates) > 0 and dates[0] < rebalancing_date
+        return bool(dates) and dates[0] < rebalancing_date
 
     def get_previous_portfolio(self, rebalancing_date: str) -> Portfolio:
-        if not self.has_previous_portfolio(rebalancing_date):
-            return Portfolio.empty()
-        yesterday = [x for x in self.get_rebalancing_dates() if x < rebalancing_date][-1]
-        return self.get_portfolio(yesterday)
+        dates = self.get_rebalancing_dates()
+        pos = bisect.bisect_left(dates, rebalancing_date)
+        return self.portfolios[pos - 1] if pos else Portfolio.empty()
 
     def get_initial_portfolio(self, rebalancing_date: str) -> Portfolio:
-        if self.has_previous_portfolio(rebalancing_date=rebalancing_date):
+        if self.has_previous_portfolio(rebalancing_date):
             return self.get_previous_portfolio(rebalancing_date)
         return Portfolio(rebalancing_date=None, weights={})
 
-    def __repr__(self):
-        return f"Strategy(portfolios={self.portfolios})"
-
     def number_of_assets(self, th: float = 0.0001) -> pd.Series:
-        return self.get_weights_df().apply(lambda x: sum(np.abs(x) > th), axis=1)
+        return (self.get_weights_df().abs() > th).sum(axis=1)
 
-    def turnover(self, return_series, rescale=True) -> pd.Series:
-        dates = self.get_rebalancing_dates()
-        turnover = {}
-        for rebalancing_date in dates:
-            previous_portfolio = self.get_previous_portfolio(rebalancing_date)
-            current_portfolio = self.get_portfolio(rebalancing_date)
-            if previous_portfolio.rebalancing_date is None:
-                # First rebalance: the full initial acquisition is traded.
-                # (The reference's empty-previous branch degenerates to 0
-                # through a None end_date — SURVEY.md section 2.)
-                turnover[rebalancing_date] = (
-                    pd.Series(current_portfolio.weights).abs().sum()
-                )
-                continue
-            turnover[rebalancing_date] = current_portfolio.turnover(
-                portfolio=previous_portfolio,
-                return_series=return_series,
-                rescale=rescale,
-            )
-        return pd.Series(turnover)
+    def turnover(self, return_series, rescale: bool = True) -> pd.Series:
+        """Per-date traded volume. The first rebalance books the full
+        initial acquisition (the reference's empty-previous branch
+        degenerates to zero through a None end date)."""
+        out = {}
+        for p in self.portfolios:
+            prev = self.get_previous_portfolio(p.rebalancing_date)
+            if prev.rebalancing_date is None:
+                out[p.rebalancing_date] = float(p._w.abs().sum())
+            else:
+                out[p.rebalancing_date] = p.turnover(
+                    portfolio=prev, return_series=return_series,
+                    rescale=rescale)
+        return pd.Series(out)
 
     def simulate(self,
-                 return_series=None,
+                 return_series: Optional[pd.DataFrame] = None,
                  fc: float = 0,
                  vc: float = 0,
                  n_days_per_year: int = 252) -> pd.Series:
-        """Pandas return engine (reference ``portfolio.py:205-245`` parity).
+        """Daily strategy returns net of costs.
 
-        For the device-vectorized equivalent see
+        Per holding period: drift the weights (un-rescaled), add the
+        constant margin/cash/loan sleeves implied by the period's
+        long/short gross, and difference the summed level. Variable
+        costs subtract turnover * ``vc`` at each rebalance; fixed costs
+        compound ``fc`` over calendar-day gaps.
+
+        The device-vectorized equivalent is
         :func:`porqua_tpu.accounting.simulate`.
         """
-        rebdates = self.get_rebalancing_dates()
-        ret_list = []
-        for rebdate in rebdates:
-            next_rebdate = (
-                rebdates[rebdates.index(rebdate) + 1]
-                if rebdate < rebdates[-1]
-                else return_series.index[-1]
-            )
-            portfolio = self.get_portfolio(rebdate)
-            w_float = portfolio.float_weights(
-                return_series=return_series, end_date=next_rebdate, rescale=False
-            )
-            short_positions = [v for v in portfolio.weights.values() if v < 0]
-            long_positions = [v for v in portfolio.weights.values() if v >= 0]
-            margin = abs(sum(short_positions))
-            cash = max(min(1 - sum(long_positions), 1), 0)
-            loan = 1 - (sum(long_positions) + cash) - (sum(short_positions) + margin)
-            w_float.insert(0, "margin", margin)
-            w_float.insert(0, "cash", cash)
-            w_float.insert(0, "loan", loan)
-            level = w_float.sum(axis=1)
-            ret_list.append(level.pct_change(1))
+        dates = self.get_rebalancing_dates()
+        period_ends = dates[1:] + [return_series.index[-1]]
 
-        portf_ret = pd.concat(ret_list).dropna()
+        pieces = []
+        for date, period_end in zip(dates, period_ends):
+            p = self.get_portfolio(date)
+            drift = p.float_weights(return_series, period_end,
+                                    rescale=False)
+            w = p._w
+            long_total = float(w[w >= 0].sum())
+            short_total = float(w[w < 0].sum())
+            margin = abs(short_total)
+            cash = min(max(1.0 - long_total, 0.0), 1.0)
+            loan = (1.0 - (long_total + cash)
+                    - (short_total + margin))
+            level = drift.sum(axis=1) + (margin + cash + loan)
+            pieces.append(level.pct_change())
+        returns = pd.concat(pieces).dropna()
 
         if vc != 0:
-            to = self.turnover(return_series=return_series, rescale=False)
-            varcost = to * vc
-            portf_ret.iloc[0] -= varcost.iloc[0]
-            portf_ret[varcost[1:].index] -= varcost[1:].values
+            traded = self.turnover(return_series=return_series,
+                                   rescale=False) * vc
+            # The first rebalance date has no return row; its cost hits
+            # the first available return instead.
+            returns.iloc[0] -= traded.iloc[0]
+            returns[traded.index[1:]] -= traded.iloc[1:].values
         if fc != 0:
-            n_days = (
-                (portf_ret.index[1:] - portf_ret.index[:-1])
-                .to_numpy()
-                .astype("timedelta64[D]")
-                .astype(int)
-            )
-            fixcost = (1 + fc) ** (n_days / n_days_per_year) - 1
-            portf_ret.iloc[1:] -= fixcost
+            gaps = np.diff(returns.index.to_numpy()).astype(
+                "timedelta64[D]").astype(int)
+            returns.iloc[1:] -= (1 + fc) ** (gaps / n_days_per_year) - 1
 
-        return portf_ret
-
-
-def floating_weights(X, w, start_date, end_date, rescale=True):
-    """Drift weights by cumulative returns (reference ``portfolio.py:254-288``)."""
-    start_date = pd.to_datetime(start_date)
-    end_date = pd.to_datetime(end_date)
-    if start_date < X.index[0]:
-        raise ValueError("start_date must be contained in dataset")
-    if end_date > X.index[-1]:
-        raise ValueError("end_date must be contained in dataset")
-
-    w = pd.Series(w, index=w.keys())
-    if w.isna().any():
-        raise ValueError("weights (w) contain NaN which is not allowed.")
-    w = w.to_frame().T
-    xnames = X.columns
-    wnames = w.columns
-    if not all(wnames.isin(xnames)):
-        raise ValueError("Not all assets in w are contained in X.")
-
-    X_tmp = X.loc[start_date:end_date, wnames].copy().fillna(0)
-    xmat = 1 + X_tmp
-    xmat.iloc[0] = w.dropna(how="all").fillna(0)
-    w_float = xmat.cumprod()
-
-    if rescale:
-        w_float_long = (
-            w_float.where(w_float >= 0)
-            .div(w_float[w_float >= 0].abs().sum(axis=1), axis="index")
-            .fillna(0)
-        )
-        w_float_short = (
-            w_float.where(w_float < 0)
-            .div(w_float[w_float < 0].abs().sum(axis=1), axis="index")
-            .fillna(0)
-        )
-        w_float = pd.DataFrame(w_float_long + w_float_short, index=xmat.index, columns=wnames)
-
-    return w_float
+        return returns

@@ -68,14 +68,23 @@ def test_geq_rows_are_sign_flipped(cons):
 
 def test_canonical_interval_equivalence(cons, universe):
     """to_canonical must encode exactly the same polytope: eq rows get
-    l == u, ineq rows get l = -inf."""
+    l == u, one-sided ineq rows keep exactly one infinite bound (a
+    ``>=`` row may stay as a finite-lower/infinite-upper interval — no
+    sign flip is required in interval form)."""
     n = len(universe)
     qp = cons.to_canonical()
     assert qp.n == n
     assert qp.m == 6  # 4 eq + 2 ineq
     l, u = np.asarray(qp.l), np.asarray(qp.u)
     np.testing.assert_allclose(l[:4], u[:4])
-    assert np.all(np.isneginf(l[4:]))
+    assert np.all(np.isinf(l[4:]) != np.isinf(u[4:]))
+    # The 'w5 >= 0.01' row must appear with its original orientation
+    # preserved up to sign: either (0.01 <= w5) or (-w5 <= -0.01).
+    C = np.asarray(qp.C)
+    row = next(i for i in range(4, 6) if abs(C[i, 5]) == 1.0 and
+               abs(C[i]).sum() == 1.0)
+    bound = l[row] if C[row, 5] > 0 else -u[row]
+    assert bound == pytest.approx(0.01)
     np.testing.assert_allclose(np.asarray(qp.lb), 0.0)
     np.testing.assert_allclose(np.asarray(qp.ub), 0.2)
 
