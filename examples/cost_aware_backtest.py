@@ -100,6 +100,7 @@ def main():
             problems.qp, n_assets=n,
             w_init=np.full(n, 1.0 / n), transaction_cost=TC,
             params=SolverParams(eps_abs=1e-8, eps_rel=1e-8, max_iter=20000),
+            universes=problems.universes,
         )
         holder["value"] = sols.x
     chain_turnover = float(np.abs(np.diff(np.asarray(sols.x)[:, :n], axis=0)).sum())

@@ -122,11 +122,29 @@ def solve_batch(problems: BatchProblems,
                           l1_center=problems.l1_center)
 
 
+def _require_fixed_universe(universes) -> None:
+    """Both scan paths carry holdings positionally: variable j must mean
+    the same asset on every date, or costs/bounds bind across unrelated
+    assets. Raise when per-date universes differ."""
+    if universes is None:
+        return
+    first = list(universes[0])
+    for i, uni in enumerate(universes):
+        if list(uni) != first:
+            raise ValueError(
+                f"scan-coupled solves require one fixed asset universe "
+                f"across dates (the scan carry is positional); date {i} "
+                f"differs from date 0. Mask exits with lb = ub = 0 "
+                f"instead of shrinking the selection.")
+
+
 def solve_scan_turnover(qp: CanonicalQP,
                         n_assets: int,
                         row_start: int,
                         w_init: jax.Array,
-                        params: SolverParams = SolverParams()) -> QPSolution:
+                        params: SolverParams = SolverParams(),
+                        universes: Optional[Sequence[Sequence[str]]] = None
+                        ) -> QPSolution:
     """Pass 2, turnover-coupled dates: ``lax.scan`` with warm starts.
 
     When a turnover constraint chains dates through the previous
@@ -141,8 +159,10 @@ def solve_scan_turnover(qp: CanonicalQP,
 
     ``qp`` is a stacked batch (leading axis = dates) built with
     placeholder x0 = 0; ``w_init`` is the pre-backtest holdings vector
-    (zeros for a cash start).
+    (zeros for a cash start). Pass ``universes`` (per-date asset lists)
+    to have the fixed-universe precondition checked.
     """
+    _require_fixed_universe(universes)
     n = n_assets
     dtype = qp.P.dtype
     nvar, m = qp.P.shape[-1], qp.C.shape[-2]
@@ -174,7 +194,8 @@ def solve_scan_l1(qp: CanonicalQP,
                   n_assets: int,
                   w_init: jax.Array,
                   transaction_cost: float,
-                  params: SolverParams = SolverParams()) -> QPSolution:
+                  params: SolverParams = SolverParams(),
+                  universes: Optional[Sequence[Sequence[str]]] = None) -> QPSolution:
     """Turnover-cost-coupled dates via ``lax.scan`` with the native prox.
 
     The sequential analog of :func:`solve_scan_turnover` for the
@@ -189,11 +210,14 @@ def solve_scan_l1(qp: CanonicalQP,
     ``qp`` is a stacked batch (leading axis = dates) of problems over
     the SAME, identically-ordered asset universe: the carry is
     positional, so variable j must mean the same asset on every date —
-    a date-varying selection charges costs between unrelated assets
-    with no error (build with a fixed universe, masking exits via
-    lb = ub = 0, when chaining costs). ``w_init`` is the pre-backtest
-    holdings vector (zeros for a cash start), padded to the problem's n.
+    a date-varying selection would charge costs between unrelated
+    assets. Pass ``universes`` (the per-date asset lists from
+    :class:`BatchProblems`) to have this checked; build with a fixed
+    universe, masking exits via lb = ub = 0, when chaining costs.
+    ``w_init`` is the pre-backtest holdings vector (zeros for a cash
+    start), padded to the problem's n.
     """
+    _require_fixed_universe(universes)
     dtype = qp.P.dtype
     nvar, m = qp.P.shape[-1], qp.C.shape[-2]
     tc = jnp.asarray(transaction_cost, dtype)

@@ -70,16 +70,42 @@ def shard_qp_batch(qp: CanonicalQP, mesh: Mesh, n_batch_axes: int = 1) -> Canoni
     )
 
 
+def _trivial_problem_like(qp: CanonicalQP) -> CanonicalQP:
+    """One near-free filler problem with the batch's static shapes:
+    identity objective, all constraint rows masked out, bounds pinning
+    every variable to zero — ADMM converges on it in a handful of
+    iterations, so mesh padding costs (almost) nothing."""
+    n, m = qp.n, qp.m
+    dt = qp.P.dtype
+    zeros_n = jnp.zeros((1, n), dt)
+    return CanonicalQP(
+        P=jnp.eye(n, dtype=dt)[None],
+        q=zeros_n,
+        C=jnp.zeros((1, m, n), dt),
+        l=jnp.zeros((1, m), dt),
+        u=jnp.zeros((1, m), dt),
+        lb=zeros_n,
+        ub=zeros_n,
+        var_mask=jnp.ones((1, n), dt),
+        row_mask=jnp.zeros((1, m), dt),
+        constant=jnp.zeros((1,), dt),
+    )
+
+
 def pad_batch_to_mesh(qp: CanonicalQP, mesh_size: int) -> Tuple[CanonicalQP, int]:
-    """Pad the leading axis to a multiple of the mesh size (XLA requires an
-    even split); returns (padded batch, real count)."""
+    """Pad the leading axis to a multiple of the mesh size (XLA requires
+    an even split); returns (padded batch, real count). Filler slots are
+    trivial pinned-to-zero problems, not copies of real ones — re-solving
+    duplicated QPs would waste a full solve per padded slot."""
     n_real = qp.P.shape[0]
     rem = (-n_real) % mesh_size
     if rem == 0:
         return qp, n_real
-    reps = -(-rem // n_real)  # rem may exceed n_real on large meshes
+    filler = _trivial_problem_like(qp)
     pad = jax.tree.map(
-        lambda a: jnp.concatenate([a] + [a] * reps, axis=0)[: n_real + rem], qp
+        lambda a, f: jnp.concatenate(
+            [a, jnp.broadcast_to(f, (rem,) + f.shape[1:])], axis=0),
+        qp, filler,
     )
     return pad, n_real
 

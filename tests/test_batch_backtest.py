@@ -240,3 +240,21 @@ def test_scan_l1_matches_serial_cost_chain(rng):
         np.testing.assert_allclose(
             np.asarray(sols.x[d])[:n], serial_ws[d], atol=1e-5
         )
+
+
+def test_scan_l1_rejects_varying_universe(rng):
+    """The scan carry is positional: a date-varying selection must be
+    refused, not silently mispriced."""
+    from porqua_tpu.batch import solve_scan_l1
+
+    n = 4
+    qps = [CanonicalQP.build(
+        np.eye(n), np.zeros(n), C=np.ones((1, n)), l=np.ones(1),
+        u=np.ones(1), lb=np.zeros(n), ub=np.ones(n), dtype=jnp.float64,
+    ) for _ in range(2)]
+    with pytest.raises(ValueError, match="fixed asset universe"):
+        solve_scan_l1(
+            stack_qps(qps), n_assets=n, w_init=np.zeros(n),
+            transaction_cost=0.01,
+            universes=[["A", "B", "C", "D"], ["A", "B", "C", "E"]],
+        )

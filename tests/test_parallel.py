@@ -88,3 +88,15 @@ def test_pad_batch_smaller_than_mesh(rng):
     sol = solve_qp_sharded(small, mesh, TIGHT)
     ref = solve_qp_batch(small, TIGHT)
     np.testing.assert_allclose(np.asarray(sol.x), np.asarray(ref.x), atol=1e-8)
+
+
+def test_pad_slots_are_trivial(rng):
+    """Filler slots must be near-free pinned-to-zero problems, not
+    duplicated real solves."""
+    small = stack_qps([portfolio_qp(rng, 6) for _ in range(3)])
+    padded, n_real = pad_batch_to_mesh(small, 8)
+    sol = solve_qp_batch(padded, TIGHT)
+    filler_iters = np.asarray(sol.iters)[n_real:]
+    real_iters = np.asarray(sol.iters)[:n_real]
+    assert np.all(np.asarray(sol.x)[n_real:] == 0.0)
+    assert filler_iters.max() <= real_iters.min()
