@@ -187,6 +187,19 @@ def _backend_scipy(parts, params):
     return res.x, None, None, bool(res.success)
 
 
+def _backend_ipm(parts, params):
+    """Algorithmically independent high-accuracy reference: dense f64
+    Mehrotra predictor-corrector interior point (the method family of
+    the reference's default cvxopt backend) — see
+    :mod:`porqua_tpu.qp.ipm`. The ADMM implementations (device, Pallas,
+    C++) share one algorithm and could share a bug; this one cannot."""
+    from porqua_tpu.qp.ipm import dual_for_canonical, solve_ipm
+
+    sol = solve_ipm(parts, tol=max(params.eps_abs * 1e-4, 1e-12))
+    y_rows, mu_box = dual_for_canonical(parts, sol)
+    return sol.x, y_rows, mu_box, sol.found
+
+
 def _backend_qpsolvers(name):
     def run(parts, params):
         import qpsolvers
@@ -231,6 +244,7 @@ def available_backends() -> Dict[str, Callable]:
     if jax.config.jax_enable_x64:
         backends["device-admm-f64"] = _backend_device(jnp.float64)
     backends["scipy-slsqp"] = _backend_scipy
+    backends["ipm-f64"] = _backend_ipm
     try:
         from porqua_tpu.native import build_library
 
