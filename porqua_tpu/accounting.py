@@ -31,6 +31,45 @@ import numpy as np
 import pandas as pd
 
 
+def _renormalize_long_short(w: jax.Array) -> jax.Array:
+    """Row-wise long/short renormalization: each row's long side is
+    scaled to sum to +1 of its own gross and the short side to -1 of
+    its (reference ``portfolio.py:283-286``). Rows with an empty side
+    contribute zero for that side. The raw drift is computed first and
+    each row renormalized independently, so renormalizing only the rows
+    one consumes is equivalent to renormalizing the full path."""
+    longs = jnp.maximum(w, 0.0)
+    shorts = w - longs
+    long_gross = jnp.sum(longs, axis=-1, keepdims=True)
+    short_gross = jnp.sum(jnp.abs(shorts), axis=-1, keepdims=True)
+    safe = lambda part, tot: jnp.where(
+        tot > 0.0, part / jnp.maximum(tot, 1e-30), 0.0)
+    return safe(longs, long_gross) + safe(shorts, short_gross)
+
+
+def drift_weights(weights: jax.Array,
+                  returns: jax.Array,
+                  reb_idx: jax.Array,
+                  rescale: bool = False) -> jax.Array:
+    """Drifted weights for every day under its active segment.
+
+    Device equivalent of the reference's ``floating_weights``
+    (``portfolio.py:254-288``) over a whole backtest at once: one global
+    cumulative product, segment assignment by ``searchsorted``, and —
+    with ``rescale`` — the long/short renormalization applied row-wise.
+    Days before the first rebalance hold the first segment's seed.
+    """
+    weights = jnp.asarray(weights, returns.dtype)
+    reb_idx = jnp.asarray(reb_idx, jnp.int32)
+    T = returns.shape[0]
+    G = jnp.cumprod(1.0 + returns, axis=0)
+    days = jnp.arange(T)
+    seg = jnp.clip(jnp.searchsorted(reb_idx, days, side="left") - 1,
+                   0, weights.shape[0] - 1)
+    w_float = weights[seg] * G / G[reb_idx[seg]]
+    return _renormalize_long_short(w_float) if rescale else w_float
+
+
 class SimulationResult(NamedTuple):
     returns: jax.Array      # (T,) daily strategy returns; 0 before the first rebdate
     valid: jax.Array        # (T,) bool, True where a return is defined
@@ -44,7 +83,8 @@ def simulate(weights: jax.Array,
              vc: float = 0.0,
              fc: float = 0.0,
              day_gaps: Optional[jax.Array] = None,
-             n_days_per_year: int = 252) -> SimulationResult:
+             n_days_per_year: int = 252,
+             rescale_turnover: bool = False) -> SimulationResult:
     """Simulate a rebalanced strategy (reference ``portfolio.py:205-245``).
 
     Args:
@@ -56,6 +96,10 @@ def simulate(weights: jax.Array,
       fc: fixed cost rate per year, compounded by calendar-day gaps.
       day_gaps: (T,) calendar days since the previous row (0 for the
         first); required when ``fc != 0``.
+      rescale_turnover: measure turnover against the long/short
+        renormalized drift of the previous portfolio (the reference's
+        ``turnover(rescale=True)`` default, ``portfolio.py:109-121``)
+        instead of the raw drift.
     """
     dtype = returns.dtype
     T, _ = returns.shape
@@ -94,12 +138,15 @@ def simulate(weights: jax.Array,
     valid = (seg >= 0) & (days > reb_idx[0])
     ret = jnp.where(valid, ret, 0.0)
 
-    # Turnover (rescale=False): drifted previous weights at the rebalance
-    # date vs the new weights (reference portfolio.py:109-121, 194-203).
+    # Turnover: drifted previous weights at the rebalance date vs the
+    # new weights (reference portfolio.py:109-121, 194-203), with the
+    # drift optionally long/short-renormalized first.
     prev_seg = jnp.maximum(jnp.arange(weights.shape[0]) - 1, 0)
     g_at_reb = G[reb_idx]                                          # (D, N)
     g_prev_seed = G[reb_idx[prev_seg]]
     w_drift_prev = weights[prev_seg] * g_at_reb / g_prev_seed      # (D, N)
+    if rescale_turnover:
+        w_drift_prev = _renormalize_long_short(w_drift_prev)
     to = jnp.sum(jnp.abs(w_drift_prev - weights), axis=1)
     to = to.at[0].set(jnp.sum(jnp.abs(weights[0])))
 
@@ -125,7 +172,8 @@ def simulate(weights: jax.Array,
                             levels=jnp.where(seg >= 0, level, 1.0))
 
 
-_simulate_jit = jax.jit(simulate, static_argnames=("vc", "fc", "n_days_per_year"))
+_simulate_jit = jax.jit(simulate, static_argnames=(
+    "vc", "fc", "n_days_per_year", "rescale_turnover"))
 
 
 def simulate_strategy(strategy,

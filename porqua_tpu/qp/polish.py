@@ -31,8 +31,21 @@ from porqua_tpu.qp.ruiz import Scaling
 def polish(qp: CanonicalQP,
            scaling: Scaling,
            params: SolverParams,
-           x, z, w, y, mu):
-    """One polish pass; returns possibly-improved (x, z, w, y, mu)."""
+           x, z, w, y, mu,
+           l1_weight=None,
+           l1_center=None):
+    """One polish pass; returns possibly-improved (x, z, w, y, mu).
+
+    With a native L1 term (``l1_weight``/``l1_center``, scaled frame)
+    the polish is *prox-aware*: variables resting on the kink
+    (x_i ~ c_i) are pinned there as active equalities, while for the
+    rest the L1 term is locally smooth with fixed gradient
+    ``w_i sign(x_i - c_i)``, which simply shifts q. The resulting KKT
+    system is smooth again, so cost-aware dates get the same
+    high-accuracy finish as plain ones; the returned ``mu`` carries the
+    L1 subgradient exactly as the ADMM iterate's does, keeping the
+    residual accounting consistent.
+    """
     dtype = qp.P.dtype
     n, m = qp.n, qp.m
     delta = jnp.asarray(params.polish_delta, dtype)
@@ -40,6 +53,25 @@ def polish(qp: CanonicalQP,
     # Active sets from dual signs, with a slack-proximity fallback so
     # weakly-active constraints (tiny dual) are still caught.
     slack_tol = 1e3 * jnp.asarray(params.eps_abs, dtype)
+
+    has_l1 = l1_weight is not None
+    if has_l1:
+        # Kink classification must NOT scale with the solve tolerance:
+        # at a loose eps the iterate sits far from the optimum and an
+        # eps-sized window would pin every variable. A dtype-resolution
+        # window classifies only genuine kink-resters; misclassified
+        # sign patterns are caught by the dual-feasibility guard below.
+        kink_tol = jnp.sqrt(jnp.asarray(jnp.finfo(dtype).eps, dtype))
+        l1c = jnp.zeros(n, dtype) if l1_center is None else l1_center
+        live = l1_weight > 0
+        at_kink = live & (jnp.abs(x - l1c) <= kink_tol)
+        sub_sign = jnp.where(live & ~at_kink, jnp.sign(x - l1c), 0.0)
+        q_eff = qp.q + l1_weight * sub_sign
+    else:
+        at_kink = jnp.zeros(n, bool)
+        sub_sign = jnp.zeros(n, dtype)
+        q_eff = qp.q
+        l1c = jnp.zeros(n, dtype)
     act_low_C = (y < -slack_tol) | (jnp.isfinite(qp.l) & (z - qp.l <= slack_tol))
     act_up_C = (y > slack_tol) | (jnp.isfinite(qp.u) & (qp.u - z <= slack_tol))
     # Equality rows are always active (l == u)
@@ -51,9 +83,12 @@ def polish(qp: CanonicalQP,
     act_low_B = (mu < -slack_tol) | (jnp.isfinite(qp.lb) & (w - qp.lb <= slack_tol))
     act_up_B = (mu > slack_tol) | (jnp.isfinite(qp.ub) & (qp.ub - w <= slack_tol))
     eq_B = jnp.isfinite(qp.lb) & jnp.isfinite(qp.ub) & ((qp.ub - qp.lb) <= 1e-10)
-    act_B = act_low_B | act_up_B | eq_B
+    act_B = act_low_B | act_up_B | eq_B | at_kink
     bound_B = jnp.where(act_up_B & ~act_low_B, qp.ub, qp.lb)
     bound_B = jnp.where(jnp.isfinite(bound_B), bound_B, 0.0)
+    # A variable resting on the L1 kink is pinned there (clipped into
+    # the box in case the kink sits outside it).
+    bound_B = jnp.where(at_kink, jnp.clip(l1c, qp.lb, qp.ub), bound_B)
 
     aC = act_C.astype(dtype)
     aB = act_B.astype(dtype)
@@ -74,7 +109,7 @@ def polish(qp: CanonicalQP,
         axis=1,
     )
     KKT = jnp.concatenate([top, midC, midB], axis=0)
-    rhs = jnp.concatenate([-qp.q, aC * bound_C, aB * bound_B])
+    rhs = jnp.concatenate([-q_eff, aC * bound_C, aB * bound_B])
 
     lu = lu_factor(KKT)
     sol = lu_solve(lu, rhs)
@@ -84,7 +119,11 @@ def polish(qp: CanonicalQP,
 
     x_p = sol[:n]
     y_p = sol[n:n + m]
-    mu_p = sol[n + m:]
+    tau_p = sol[n + m:]
+    # Fold the fixed L1 subgradient back into the box dual so the
+    # stationarity vector P x + q + C'y + mu is evaluated against the
+    # ORIGINAL q, matching how the ADMM iterate carries the L1 term.
+    mu_p = tau_p + (l1_weight * sub_sign if has_l1 else 0.0)
     z_p = jnp.clip(qp.C @ x_p, qp.l, qp.u)
     w_p = jnp.clip(x_p, qp.lb, qp.ub)
 
@@ -93,6 +132,22 @@ def polish(qp: CanonicalQP,
     rp1, rd1, *_ = _residuals(qp, scaling, x_p, z_p, w_p, y_p, mu_p, params)
     finite = jnp.all(jnp.isfinite(x_p)) & jnp.all(jnp.isfinite(y_p))
     better = finite & (jnp.maximum(rp1, rd1) < jnp.maximum(rp0, rd0))
+
+    if has_l1:
+        # The stationarity residual cannot see an invalid L1
+        # subgradient (mu absorbs whatever the KKT solve implies), so a
+        # mis-guessed kink/sign pattern must be rejected explicitly:
+        # a variable pinned at the kink strictly inside the box needs
+        # its implied multiplier within [-w_i, w_i], and a smooth-side
+        # variable must not have crossed to the other side of its kink.
+        inside = (x_p > qp.lb + slack_tol) & (x_p < qp.ub - slack_tol)
+        kink_dual_ok = jnp.where(at_kink & inside,
+                                 jnp.abs(tau_p) <= l1_weight + slack_tol,
+                                 True)
+        side_ok = jnp.where(live & ~at_kink,
+                            (x_p - l1c) * sub_sign >= -kink_tol,
+                            True)
+        better = better & jnp.all(kink_dual_ok) & jnp.all(side_ok)
 
     pick = lambda a, b: jnp.where(better, a, b)
     return (

@@ -72,26 +72,14 @@ def _solve_impl(qp: CanonicalQP,
                        l1_weight=l1w_s, l1_center=l1c_s)
     x, z, w, y, mu = state.x, state.z, state.w, state.y, state.mu
 
-    # The LU polish solves the smooth-QP KKT system on the active box
-    # set; with a nonsmooth L1 term the stationarity condition carries a
-    # subgradient the polish does not model, so it applies only where
-    # the problem's L1 row is actually zero (per problem, so a batch
-    # mixing cost-free dates with costly ones still polishes the former).
+    # LU polish on the active set. With a live L1 term the polish is
+    # prox-aware (see qp.polish): kink variables are pinned, the fixed
+    # subgradient shifts q, and the smooth KKT system is solved — so
+    # cost-aware dates get the same high-accuracy finish as plain ones.
     if params.polish:
-        if l1_weight is None:
-            x, z, w, y, mu = _polish(scaled, scaling, params, x, z, w, y, mu)
-        else:
-            # lax.cond skips the (expensive) LU polish at runtime when
-            # this problem's L1 row is live; under vmap it lowers to a
-            # select computing both branches, which is exactly the
-            # mixed-batch case where some dates need the polish.
-            has_l1 = jnp.any(l1w_s > 0)
-            x, z, w, y, mu = jax.lax.cond(
-                has_l1,
-                lambda args: args,
-                lambda args: _polish(scaled, scaling, params, *args),
-                (x, z, w, y, mu),
-            )
+        x, z, w, y, mu = _polish(
+            scaled, scaling, params, x, z, w, y, mu,
+            l1_weight=l1w_s, l1_center=l1c_s)
 
     r_prim, r_dual, eps_p, eps_d, _, _ = _residuals(
         scaled, scaling, x, z, w, y, mu, params
