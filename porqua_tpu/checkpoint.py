@@ -66,14 +66,22 @@ class CheckpointManager:
     params_key: str
 
     @staticmethod
-    def _key(params: SolverParams) -> str:
-        return json.dumps(dataclasses.asdict(params), sort_keys=True)
+    def _key(params: SolverParams, dtype=None, has_l1: bool = False) -> str:
+        # dtype and the l1 configuration change the numerical content of
+        # a chunk, so they are part of the run identity — resuming with a
+        # different dtype must not silently mix f32 and f64 chunks.
+        key = dataclasses.asdict(params)
+        key["dtype"] = str(jnp.dtype(dtype)) if dtype is not None else None
+        key["has_l1"] = bool(has_l1)
+        return json.dumps(key, sort_keys=True)
 
     @classmethod
     def create(cls, directory: str, rebdates: List[str], chunk_size: int,
-               params: SolverParams) -> "CheckpointManager":
+               params: SolverParams, dtype=None,
+               has_l1: bool = False) -> "CheckpointManager":
         os.makedirs(directory, exist_ok=True)
-        mgr = cls(directory, list(rebdates), int(chunk_size), cls._key(params))
+        mgr = cls(directory, [str(d) for d in rebdates], int(chunk_size),
+                  cls._key(params, dtype, has_l1))
         manifest_path = os.path.join(directory, "manifest.json")
         manifest = {
             "rebdates": mgr.rebdates,
@@ -145,7 +153,8 @@ def run_batch_checkpointed(bs,
     params = SolverParams() if params is None else params
     problems = build_problems(bs, dtype=dtype)
     mgr = CheckpointManager.create(
-        directory, problems.rebdates, chunk_size, params
+        directory, problems.rebdates, chunk_size, params,
+        dtype=dtype, has_l1=problems.l1_weight is not None,
     )
 
     start = mgr.completed_chunks()

@@ -1,25 +1,32 @@
-"""Learning-to-rank asset selection scoring.
+"""Learning-to-rank asset selection scoring — pairwise, in JAX.
 
 Working replacement for the reference's stale XGBoost LTR bibfn
 (reference ``src/builders.py:138-180``, which references an undefined
 ``selected`` variable and a missing ``import xgb`` — SURVEY.md section
-2). Scores assets at a rebalance date by a pairwise-ranking gradient
-boosted model trained on trailing feature/return cross-sections.
+2) and its pairwise ``xgb.XGBRanker`` workflow (reference
+``example/ml.ipynb`` cell 18, objective ``rank:pairwise``).
 
-xgboost is not available in this image; the model backend is
-sklearn's HistGradientBoostingRegressor fit on rank-transformed labels
-(a pointwise LTR surrogate), which keeps the bibfn contract identical:
-it returns a DataFrame with ``scores`` and a ``binary`` column marking
-the top-k ranked assets. Training runs host-side, off the hot path —
-the same placement the reference uses.
+xgboost is not available in this image; instead of a pointwise
+regression surrogate, the ranker here optimizes a genuine *pairwise*
+ranking loss (RankNet: logistic loss on score differences of
+discordant pairs within each date's cross-section) with a small MLP
+scorer — trained as one jitted ``lax.scan`` over full-batch Adam steps,
+so the whole fit is a single XLA program. Ranking quality is measured
+with NDCG@k (:func:`porqua_tpu.models.lstm.ndcg`). Training runs once
+per rebalance date off the hot path — the same placement the reference
+uses.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 import pandas as pd
+
+import jax
+import jax.numpy as jnp
 
 
 def _rank_labels(returns: pd.Series, n_bins: int = 10) -> pd.Series:
@@ -29,6 +36,122 @@ def _rank_labels(returns: pd.Series, n_bins: int = 10) -> pd.Series:
     return rank_labels(returns, n_bins=n_bins, ascending=True)
 
 
+def pairwise_logistic_loss(scores: jax.Array,
+                           labels: jax.Array,
+                           mask: jax.Array) -> jax.Array:
+    """RankNet loss for one group: mean softplus(-(s_i - s_j)) over
+    pairs with label_i > label_j (both valid under ``mask``).
+
+    The all-pairs difference matrices vectorize the loss — no Python
+    pair loops, fixed shapes, so ``vmap`` over groups is free.
+    """
+    s_diff = scores[:, None] - scores[None, :]
+    l_diff = labels[:, None] - labels[None, :]
+    valid = (mask[:, None] > 0) & (mask[None, :] > 0)
+    pair = valid & (l_diff > 0)
+    losses = jnp.where(pair, jax.nn.softplus(-s_diff), 0.0)
+    n_pairs = jnp.maximum(jnp.sum(pair), 1)
+    return jnp.sum(losses) / n_pairs
+
+
+def _init_mlp(key, sizes: Sequence[int]):
+    params = []
+    for k, (d_in, d_out) in zip(
+            jax.random.split(key, len(sizes) - 1),
+            zip(sizes[:-1], sizes[1:])):
+        w = jax.random.normal(k, (d_in, d_out)) * jnp.sqrt(2.0 / d_in)
+        params.append({"w": w, "b": jnp.zeros((d_out,))})
+    return params
+
+
+def _apply_mlp(params, X):
+    h = X
+    for layer in params[:-1]:
+        h = jax.nn.relu(h @ layer["w"] + layer["b"])
+    out = h @ params[-1]["w"] + params[-1]["b"]
+    return out[..., 0]
+
+
+@dataclasses.dataclass
+class PairwiseRanker:
+    """MLP scorer trained with the RankNet pairwise loss.
+
+    ``fit`` takes per-date groups (feature matrix, label vector); groups
+    are padded to a common size and stacked so the whole training loop —
+    score, all-pairs loss, Adam update, scanned over epochs — is one
+    jitted XLA program.
+    """
+
+    hidden: Tuple[int, ...] = (32,)
+    epochs: int = 300
+    learning_rate: float = 0.01
+    seed: int = 0
+
+    params: Optional[list] = dataclasses.field(default=None, repr=False)
+    _norm: Optional[Tuple[np.ndarray, np.ndarray]] = dataclasses.field(
+        default=None, repr=False)
+
+    def fit(self, groups: List[Tuple[np.ndarray, np.ndarray]]):
+        import optax
+
+        n_feat = groups[0][0].shape[1]
+        max_n = max(x.shape[0] for x, _ in groups)
+        Xs = np.zeros((len(groups), max_n, n_feat), np.float32)
+        ys = np.zeros((len(groups), max_n), np.float32)
+        masks = np.zeros((len(groups), max_n), np.float32)
+        for g, (x, y) in enumerate(groups):
+            k = x.shape[0]
+            Xs[g, :k] = x
+            ys[g, :k] = y
+            masks[g, :k] = 1.0
+
+        # Feature standardization from the training pool (guarded
+        # against constant columns).
+        flat = Xs[masks > 0]
+        mean = flat.mean(axis=0)
+        std = np.where(flat.std(axis=0) > 1e-12, flat.std(axis=0), 1.0)
+        self._norm = (mean, std)
+        Xs = (Xs - mean) / std
+
+        key = jax.random.PRNGKey(self.seed)
+        sizes = (n_feat, *self.hidden, 1)
+        params = _init_mlp(key, sizes)
+        tx = optax.adam(self.learning_rate)
+        opt_state = tx.init(params)
+
+        Xd = jnp.asarray(Xs)
+        yd = jnp.asarray(ys)
+        md = jnp.asarray(masks)
+
+        def loss_fn(p):
+            scores = jax.vmap(lambda X: _apply_mlp(p, X))(Xd)
+            losses = jax.vmap(pairwise_logistic_loss)(scores, yd, md)
+            return jnp.mean(losses)
+
+        @jax.jit
+        def train(params, opt_state):
+            def step(carry, _):
+                p, s = carry
+                loss, grads = jax.value_and_grad(loss_fn)(p)
+                updates, s = tx.update(grads, s, p)
+                p = optax.apply_updates(p, updates)
+                return (p, s), loss
+
+            (params, opt_state), losses = jax.lax.scan(
+                step, (params, opt_state), None, length=self.epochs)
+            return params, losses
+
+        self.params, self._losses = train(params, opt_state)
+        return self
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        if self.params is None:
+            raise RuntimeError("fit() the ranker first")
+        mean, std = self._norm
+        Xn = jnp.asarray(((np.asarray(X) - mean) / std).astype(np.float32))
+        return np.asarray(_apply_mlp(self.params, Xn))
+
+
 def ltr_selection_scores(bs,
                          rebdate: str,
                          feature_key: str = "features",
@@ -36,33 +159,38 @@ def ltr_selection_scores(bs,
                          train_dates: int = 12,
                          horizon: int = 21,
                          top_k: Optional[int] = None,
+                         epochs: int = 300,
                          **kwargs) -> pd.DataFrame:
-    """Score the current universe with a ranking model.
+    """Score the current universe with the pairwise ranking model.
 
     ``bs.data[feature_key]``: DataFrame indexed by (date, asset) or a
     dict date -> DataFrame(asset x features). Labels are forward
     ``horizon``-day returns ranked cross-sectionally, from the
     ``train_dates`` most recent feature cross-sections before
-    ``rebdate``.
+    ``rebdate``. Mirrors the group structure the reference's
+    ``XGBRanker`` fit uses (one group per date cross-section,
+    ``example/ml.ipynb`` cell 18).
     """
-    from sklearn.ensemble import HistGradientBoostingRegressor
-
     features = bs.data.get(feature_key)
     returns = bs.data.get(return_key)
     if features is None or returns is None:
-        raise ValueError(f"'{feature_key}' and '{return_key}' data are required for LTR selection.")
+        raise ValueError(
+            f"'{feature_key}' and '{return_key}' data are required "
+            f"for LTR selection.")
 
     if isinstance(features, pd.DataFrame) and isinstance(features.index, pd.MultiIndex):
-        by_date = {d: features.xs(d, level=0) for d in features.index.get_level_values(0).unique()}
+        by_date = {d: features.xs(d, level=0)
+                   for d in features.index.get_level_values(0).unique()}
     else:
         by_date = dict(features)
 
     reb_ts = pd.to_datetime(rebdate)
-    past_dates = sorted(d for d in by_date if pd.to_datetime(d) < reb_ts)[-train_dates:]
+    past_dates = sorted(
+        d for d in by_date if pd.to_datetime(d) < reb_ts)[-train_dates:]
     if not past_dates:
         raise ValueError(f"no feature cross-sections before {rebdate}")
 
-    X_rows, y_rows = [], []
+    groups: List[Tuple[np.ndarray, np.ndarray]] = []
     for d in past_dates:
         xsec = by_date[d].dropna()
         d_ts = pd.to_datetime(d)
@@ -73,17 +201,19 @@ def ltr_selection_scores(bs,
         common = xsec.index.intersection(fwd.index)
         if len(common) < 2:
             continue
-        X_rows.append(xsec.loc[common])
-        y_rows.append(_rank_labels(fwd[common]))
-    if not X_rows:
+        groups.append((
+            xsec.loc[common].to_numpy(np.float32),
+            _rank_labels(fwd[common]).to_numpy(np.float32),
+        ))
+    if not groups:
         raise ValueError("no usable (features, forward return) training pairs")
 
-    model = HistGradientBoostingRegressor(max_iter=100, max_depth=3, random_state=0)
-    model.fit(pd.concat(X_rows).to_numpy(), pd.concat(y_rows).to_numpy())
+    model = PairwiseRanker(epochs=epochs).fit(groups)
 
     current_dates = sorted(d for d in by_date if pd.to_datetime(d) <= reb_ts)
     xsec_now = by_date[current_dates[-1]].dropna()
-    scores = pd.Series(model.predict(xsec_now.to_numpy()), index=xsec_now.index)
+    scores = pd.Series(
+        model.predict(xsec_now.to_numpy(np.float32)), index=xsec_now.index)
 
     k = top_k if top_k is not None else max(1, len(scores) // 2)
     top = scores.rank(ascending=False, method="first") <= k

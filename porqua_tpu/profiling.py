@@ -157,3 +157,128 @@ def device_trace(logdir: str):
         yield
     finally:
         jax.profiler.stop_trace()
+
+
+# ---------------------------------------------------------------------------
+# Roofline accounting: analytic FLOPs + HBM bytes for the ADMM workload
+# ---------------------------------------------------------------------------
+
+# Per-chip peak numbers (dense matmul peak, HBM bandwidth). Sources:
+# public TPU spec sheets. f32 matmul on the MXU decomposes into bf16
+# passes, so the realistic f32 ceiling is a fraction of the bf16 peak;
+# MFU is reported against the bf16 peak (the honest, conservative
+# denominator) and against a f32-highest estimate (peak/3).
+_PEAKS = {
+    # substring of jax device_kind -> (bf16 peak FLOP/s, HBM bytes/s)
+    "v6": (918e12, 1640e9),
+    "v5p": (459e12, 2765e9),
+    "v5": (197e12, 819e9),     # v5e reports device_kind "TPU v5 lite"
+    "v4": (275e12, 1228e9),
+    "v3": (123e12, 900e9),
+    "v2": (46e12, 700e9),
+}
+
+
+def device_peaks(device_kind: str):
+    """(bf16 peak FLOP/s, HBM B/s) for a jax device_kind, or (None, None)."""
+    kind = (device_kind or "").lower()
+    for key, peaks in _PEAKS.items():
+        if key in kind:
+            return peaks
+    return (None, None)
+
+
+def admm_flop_model(n: int, m: int, window: int, iters: float,
+                    n_dates: int = 1, *, segments: Optional[float] = None,
+                    check_interval: int = 25, scaling_iters: int = 10,
+                    pallas: bool = False, polish_passes: int = 3,
+                    polish_refine_steps: int = 3,
+                    l1_kkt_solves: int = 1) -> Dict[str, float]:
+    """Analytic FLOP + HBM-byte count for one batched tracking solve.
+
+    Mirrors the actual program in :mod:`porqua_tpu.tracking` /
+    :mod:`porqua_tpu.qp.admm`: Gram assembly, Ruiz equilibration, per-
+    segment KKT (re)factorization (+ explicit inverse on the Pallas
+    path), the iteration loop, per-segment residual checks, and the
+    active-set polish (full-KKT LU + refinement). All counts are per
+    problem, multiplied by ``n_dates`` at the end. ``iters`` is the
+    average iteration count actually executed (device-reported).
+    """
+    T = window
+    segs = (iters / check_interval) if segments is None else segments
+    N_kkt = 2 * n + m  # polish KKT size
+
+    flops = {}
+    flops["gram"] = 2.0 * T * n * n + 4.0 * T * n
+    flops["ruiz"] = scaling_iters * 4.0 * (m * n + n * n)
+    fact = (n ** 3) / 3.0 + 2.0 * m * n * n  # cholesky + C'rhoC assembly
+    if pallas:
+        # Explicit inverse via n-rhs cho_solve plus the one-step Newton
+        # refinement (two further n^3 HIGHEST matmuls, admm.py
+        # refined_inverse).
+        fact += 2.0 * (n ** 3) + 4.0 * (n ** 3)
+    flops["factorize"] = segs * fact
+    per_iter = (2.0 * n * n) + 4.0 * m * n + 15.0 * n
+    flops["iterate"] = iters * per_iter
+    flops["residual_checks"] = segs * (2.0 * n * n + 4.0 * m * n)
+    # Each polish pass runs `l1_kkt_solves` full-KKT LU solves (2 when a
+    # live L1 term triggers the kink-reclassification re-solve).
+    flops["polish"] = polish_passes * l1_kkt_solves * (
+        2.0 * (N_kkt ** 3) / 3.0 + (polish_refine_steps + 1) * 4.0 * N_kkt ** 2
+    )
+    flops["tracking_error"] = 2.0 * T * n
+
+    item = 4.0  # f32 bytes
+    bytes_ = {}
+    bytes_["gram"] = item * (T * n + n * n)
+    # Factor/Kinv traffic: the XLA path re-reads the factor (n^2) twice
+    # per iteration (two triangular solves); the Pallas path reads the
+    # inverse once per segment (VMEM-resident across the segment).
+    if pallas:
+        bytes_["iterate"] = segs * item * (n * n + m * n)
+        bytes_["factorize"] = segs * item * 6.0 * n * n
+    else:
+        bytes_["iterate"] = iters * item * 2.0 * (n * n) + iters * item * 2 * m * n
+        bytes_["factorize"] = segs * item * 4.0 * n * n
+    bytes_["polish"] = polish_passes * item * (
+        3.0 * N_kkt ** 2 + polish_refine_steps * 2.0 * N_kkt ** 2
+    )
+
+    total_flops = float(sum(flops.values())) * n_dates
+    total_bytes = float(sum(bytes_.values())) * n_dates
+    return {
+        "flops_total": total_flops,
+        "bytes_total": total_bytes,
+        "flops_breakdown": {k: v * n_dates for k, v in flops.items()},
+        "bytes_breakdown": {k: v * n_dates for k, v in bytes_.items()},
+    }
+
+
+def roofline_report(model: Dict[str, float], seconds: float,
+                    device_kind: str = "") -> Dict[str, Any]:
+    """Achieved FLOP/s, HBM GB/s, and MFU vs the device's peaks.
+
+    ``model`` is :func:`admm_flop_model` output; ``seconds`` the measured
+    steady-state wall-clock of the same program. MFU is quoted against
+    the bf16 matmul peak (conservative) and a f32-highest estimate
+    (bf16/3 — f32 matmuls decompose into ~3 bf16 MXU passes).
+    """
+    peak_flops, peak_bw = device_peaks(device_kind)
+    achieved_flops = model["flops_total"] / seconds
+    achieved_bw = model["bytes_total"] / seconds
+    out: Dict[str, Any] = {
+        "achieved_tflops": achieved_flops / 1e12,
+        "achieved_hbm_gbps": achieved_bw / 1e9,
+        "model_flops": model["flops_total"],
+        "model_bytes": model["bytes_total"],
+    }
+    if peak_flops:
+        out["mfu_bf16_peak"] = achieved_flops / peak_flops
+        out["mfu_f32_est"] = achieved_flops / (peak_flops / 3.0)
+        out["hbm_utilization"] = achieved_bw / peak_bw
+        # Which wall does the model hit first at 100% utilization?
+        t_compute = model["flops_total"] / (peak_flops / 3.0)
+        t_memory = model["bytes_total"] / peak_bw
+        out["roofline_bound"] = "compute" if t_compute > t_memory else "memory"
+        out["roofline_seconds_min"] = max(t_compute, t_memory)
+    return out

@@ -50,76 +50,129 @@ def polish(qp: CanonicalQP,
     n, m = qp.n, qp.m
     delta = jnp.asarray(params.polish_delta, dtype)
 
-    # Active sets from dual signs, with a slack-proximity fallback so
-    # weakly-active constraints (tiny dual) are still caught.
-    slack_tol = 1e3 * jnp.asarray(params.eps_abs, dtype)
+    # Active sets from dual signs (OSQP's criterion), with a tight
+    # exact-on-bound proximity fallback. The dual threshold is a few
+    # ulps, not eps_abs-scaled: a loose iterate's duals are noisy, but a
+    # wrong guess only costs a rejected pass (accept-only-if-better
+    # below), while an eps_abs-sized threshold classifies everything
+    # whose dual is merely small as inactive/active wholesale.
+    prox_err = jnp.maximum(
+        jnp.max(jnp.abs(qp.C @ x - z)) if m else jnp.asarray(0.0, dtype),
+        jnp.max(jnp.abs(x - w)),
+    )
+    tiny = 1e3 * jnp.asarray(jnp.finfo(dtype).eps, dtype)
+    dual_tol = tiny
+    prox_tol = tiny
 
     has_l1 = l1_weight is not None
     if has_l1:
-        # Kink classification must NOT scale with the solve tolerance:
-        # at a loose eps the iterate sits far from the optimum and an
-        # eps-sized window would pin every variable. A dtype-resolution
-        # window classifies only genuine kink-resters; misclassified
-        # sign patterns are caught by the dual-feasibility guard below.
+        # Kink-vs-smooth classification. The iterate leaves variables
+        # that belong ON the kink up to ~its own infeasibility away from
+        # it, so primal proximity alone cannot decide: candidates within
+        # a window that tracks the iterate's error are classified by the
+        # DUAL — at (near-)optimality the combined box dual carries the
+        # L1 subgradient, strictly inside [-w, w] exactly for
+        # kink-resters, pinned at +/-w for smooth-side variables (whose
+        # side sign(mu) reports more reliably than sign(x - c) when x
+        # sits within iterate error of the kink). Misclassifications are
+        # still caught by the acceptance guards below, and repeated
+        # passes (solve.py) shrink the window as the point converges.
         kink_tol = jnp.sqrt(jnp.asarray(jnp.finfo(dtype).eps, dtype))
         l1c = jnp.zeros(n, dtype) if l1_center is None else l1_center
         live = l1_weight > 0
-        at_kink = live & (jnp.abs(x - l1c) <= kink_tol)
-        sub_sign = jnp.where(live & ~at_kink, jnp.sign(x - l1c), 0.0)
+        window = 10.0 * (prox_err + kink_tol)
+        near = live & (jnp.abs(x - l1c) <= window)
+        dual_interior = jnp.abs(mu) <= 0.75 * l1_weight
+        at_kink = near & dual_interior
+        near_smooth_sign = jnp.sign(mu)
+        far_sign = jnp.sign(x - l1c)
+        sub_sign = jnp.where(
+            live & ~at_kink, jnp.where(near, near_smooth_sign, far_sign), 0.0)
         q_eff = qp.q + l1_weight * sub_sign
+        # The combined dual mu carries the L1 subgradient (magnitude up
+        # to w_i); shrink it away so box-activity tests see only the
+        # box-dual part — otherwise every live-L1 variable looks
+        # box-active the moment w_i exceeds the dual threshold.
+        mu_box_est = mu - jnp.clip(mu, -l1_weight, l1_weight)
     else:
         at_kink = jnp.zeros(n, bool)
         sub_sign = jnp.zeros(n, dtype)
         q_eff = qp.q
         l1c = jnp.zeros(n, dtype)
-    act_low_C = (y < -slack_tol) | (jnp.isfinite(qp.l) & (z - qp.l <= slack_tol))
-    act_up_C = (y > slack_tol) | (jnp.isfinite(qp.u) & (qp.u - z <= slack_tol))
+        window = 10.0 * prox_err + tiny
+        mu_box_est = mu
+    act_low_C = (y < -dual_tol) | (jnp.isfinite(qp.l) & (z - qp.l <= prox_tol))
+    act_up_C = (y > dual_tol) | (jnp.isfinite(qp.u) & (qp.u - z <= prox_tol))
     # Equality rows are always active (l == u)
     eq_C = jnp.isfinite(qp.l) & jnp.isfinite(qp.u) & ((qp.u - qp.l) <= 1e-10)
     act_C = (act_low_C | act_up_C | eq_C) & (qp.row_mask > 0)
     bound_C = jnp.where(act_up_C & ~act_low_C, qp.u, qp.l)
     bound_C = jnp.where(jnp.isfinite(bound_C), bound_C, 0.0)
 
-    act_low_B = (mu < -slack_tol) | (jnp.isfinite(qp.lb) & (w - qp.lb <= slack_tol))
-    act_up_B = (mu > slack_tol) | (jnp.isfinite(qp.ub) & (qp.ub - w <= slack_tol))
+    act_low_B = (mu_box_est < -dual_tol) | (
+        jnp.isfinite(qp.lb) & (w - qp.lb <= prox_tol))
+    act_up_B = (mu_box_est > dual_tol) | (
+        jnp.isfinite(qp.ub) & (qp.ub - w <= prox_tol))
     eq_B = jnp.isfinite(qp.lb) & jnp.isfinite(qp.ub) & ((qp.ub - qp.lb) <= 1e-10)
-    act_B = act_low_B | act_up_B | eq_B | at_kink
     bound_B = jnp.where(act_up_B & ~act_low_B, qp.ub, qp.lb)
     bound_B = jnp.where(jnp.isfinite(bound_B), bound_B, 0.0)
-    # A variable resting on the L1 kink is pinned there (clipped into
-    # the box in case the kink sits outside it).
-    bound_B = jnp.where(at_kink, jnp.clip(l1c, qp.lb, qp.ub), bound_B)
-
-    aC = act_C.astype(dtype)
-    aB = act_B.astype(dtype)
 
     eye_n = jnp.eye(n, dtype=dtype)
-    # KKT blocks; inactive dual rows become identity rows pinning the dual to 0.
-    top = jnp.concatenate([qp.P + delta * eye_n, qp.C.T, eye_n], axis=1)
-    midC = jnp.concatenate(
-        [aC[:, None] * qp.C,
-         jnp.diag(-delta * aC + (1.0 - aC)),
-         jnp.zeros((m, n), dtype)],
-        axis=1,
-    )
-    midB = jnp.concatenate(
-        [jnp.diag(aB),
-         jnp.zeros((n, m), dtype),
-         jnp.diag(-delta * aB + (1.0 - aB))],
-        axis=1,
-    )
-    KKT = jnp.concatenate([top, midC, midB], axis=0)
-    rhs = jnp.concatenate([-q_eff, aC * bound_C, aB * bound_B])
 
-    lu = lu_factor(KKT)
-    sol = lu_solve(lu, rhs)
-    for _ in range(params.polish_refine_steps):
-        resid = rhs - KKT @ sol
-        sol = sol + lu_solve(lu, resid)
+    def kkt_solve(at_kink_i, sub_sign_i):
+        """Equality-KKT solve for one active-set/sign hypothesis."""
+        aB_i = (act_low_B | act_up_B | eq_B | at_kink_i).astype(dtype)
+        aC_i = act_C.astype(dtype)
+        bound_B_i = jnp.where(
+            at_kink_i, jnp.clip(l1c, qp.lb, qp.ub), bound_B)
+        q_eff_i = qp.q + (l1_weight * sub_sign_i if has_l1 else 0.0)
+        # KKT blocks; inactive dual rows become identity rows pinning
+        # the dual to 0.
+        top = jnp.concatenate([qp.P + delta * eye_n, qp.C.T, eye_n], axis=1)
+        midC = jnp.concatenate(
+            [aC_i[:, None] * qp.C,
+             jnp.diag(-delta * aC_i + (1.0 - aC_i)),
+             jnp.zeros((m, n), dtype)],
+            axis=1,
+        )
+        midB = jnp.concatenate(
+            [jnp.diag(aB_i),
+             jnp.zeros((n, m), dtype),
+             jnp.diag(-delta * aB_i + (1.0 - aB_i))],
+            axis=1,
+        )
+        KKT = jnp.concatenate([top, midC, midB], axis=0)
+        rhs = jnp.concatenate(
+            [-q_eff_i, aC_i * bound_C, aB_i * bound_B_i])
+        lu = lu_factor(KKT)
+        sol = lu_solve(lu, rhs)
+        for _ in range(params.polish_refine_steps):
+            resid = rhs - KKT @ sol
+            sol = sol + lu_solve(lu, resid)
+        return sol[:n], sol[n:n + m], sol[n + m:]
 
-    x_p = sol[:n]
-    y_p = sol[n:n + m]
-    tau_p = sol[n + m:]
+    x_p, y_p, tau_p = kkt_solve(at_kink, sub_sign)
+
+    if has_l1:
+        # A smooth-classified variable whose solution crossed its kink
+        # has a mis-guessed subgradient sign; the true optimum rests ON
+        # the kink for exactly those variables. Reclassify them as
+        # pinned and re-solve (one inner active-set refinement step) —
+        # the KKT residuals cannot catch this themselves because mu
+        # absorbs whatever subgradient the solve implies.
+        kt = jnp.asarray(kink_tol, dtype)
+        crossed = live & ~at_kink & ((x_p - l1c) * sub_sign < -kt)
+        any_crossed = jnp.any(crossed)
+        at_kink2 = at_kink | crossed
+        sub_sign2 = jnp.where(crossed, 0.0, sub_sign)
+        x_p2, y_p2, tau_p2 = kkt_solve(at_kink2, sub_sign2)
+        pick2 = lambda b2, b1: jnp.where(any_crossed, b2, b1)
+        x_p = pick2(x_p2, x_p)
+        y_p = pick2(y_p2, y_p)
+        tau_p = pick2(tau_p2, tau_p)
+        at_kink = jnp.where(any_crossed, at_kink2, at_kink)
+        sub_sign = pick2(sub_sign2, sub_sign)
+
     # Fold the fixed L1 subgradient back into the box dual so the
     # stationarity vector P x + q + C'y + mu is evaluated against the
     # ORIGINAL q, matching how the ADMM iterate carries the L1 term.
@@ -134,15 +187,14 @@ def polish(qp: CanonicalQP,
     better = finite & (jnp.maximum(rp1, rd1) < jnp.maximum(rp0, rd0))
 
     if has_l1:
-        # The stationarity residual cannot see an invalid L1
-        # subgradient (mu absorbs whatever the KKT solve implies), so a
-        # mis-guessed kink/sign pattern must be rejected explicitly:
-        # a variable pinned at the kink strictly inside the box needs
-        # its implied multiplier within [-w_i, w_i], and a smooth-side
-        # variable must not have crossed to the other side of its kink.
-        inside = (x_p > qp.lb + slack_tol) & (x_p < qp.ub - slack_tol)
+        # A mis-guessed kink/sign pattern that survived reclassification
+        # must still be rejected: a variable pinned at the kink strictly
+        # inside the box needs its implied multiplier within
+        # [-w_i, w_i], and a smooth-side variable must sit strictly on
+        # its assumed side (up to roundoff) after the re-solve.
+        inside = (x_p > qp.lb + window) & (x_p < qp.ub - window)
         kink_dual_ok = jnp.where(at_kink & inside,
-                                 jnp.abs(tau_p) <= l1_weight + slack_tol,
+                                 jnp.abs(tau_p) <= l1_weight + window,
                                  True)
         side_ok = jnp.where(live & ~at_kink,
                             (x_p - l1c) * sub_sign >= -kink_tol,

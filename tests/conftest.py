@@ -9,18 +9,26 @@ float64 references; solver code is dtype-parametric.
 
 import os
 
+# PORQUA_TPU_TESTS=1 switches the suite to real-hardware mode: the
+# container's default backend (the TPU plugin) stays active, x64 stays
+# off (TPU has no native f64), and only tests marked `tpu` make sense —
+# run `PORQUA_TPU_TESTS=1 pytest -m tpu`. Default mode is the virtual
+# 8-device CPU backend with x64 for parity references.
+_TPU_MODE = os.environ.get("PORQUA_TPU_TESTS") == "1"
+
 _flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
+if not _TPU_MODE and "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
 
-# The environment's sitecustomize registers the axon TPU plugin and sets
-# jax_platforms="axon,cpu" via jax.config — which overrides any
-# JAX_PLATFORMS env var. Tests must run on the virtual-device CPU
-# backend, so the config (not the env) is the knob to set here.
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_enable_x64", True)
+if not _TPU_MODE:
+    # The environment's sitecustomize registers the axon TPU plugin and
+    # sets jax_platforms="axon,cpu" via jax.config — which overrides any
+    # JAX_PLATFORMS env var. Tests must run on the virtual-device CPU
+    # backend, so the config (not the env) is the knob to set here.
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
 
 import sys  # noqa: E402
 
@@ -28,6 +36,38 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "tpu: requires a real TPU backend (run with PORQUA_TPU_TESTS=1 "
+        "pytest -m tpu); skipped otherwise",
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    if _TPU_MODE:
+        if jax.default_backend() != "tpu":
+            # TPU mode was requested but no TPU came up: x64 is off and
+            # the CPU-reference tolerances are meaningless — skip
+            # everything loudly rather than failing f64 tests en masse.
+            skip = pytest.mark.skip(
+                reason="PORQUA_TPU_TESTS=1 but no TPU backend initialized")
+            for item in items:
+                item.add_marker(skip)
+            return
+        skip = pytest.mark.skip(
+            reason="real-TPU session runs only tpu-marked tests")
+        for item in items:
+            if "tpu" not in item.keywords:
+                item.add_marker(skip)
+    else:
+        skip = pytest.mark.skip(
+            reason="needs a real TPU (PORQUA_TPU_TESTS=1 and TPU backend)")
+        for item in items:
+            if "tpu" in item.keywords:
+                item.add_marker(skip)
 
 
 @pytest.fixture

@@ -206,3 +206,68 @@ def test_warm_start_reduces_iterations():
     warm = solve_qp(qp, TIGHT, x0=cold.x, y0=cold.y)
     assert int(warm.iters) <= int(cold.iters)
     np.testing.assert_allclose(np.asarray(warm.x), np.asarray(cold.x), atol=1e-6)
+
+
+class TestBackendSelection:
+    """ADVICE fixes: VMEM gating of the fused Pallas segment and the
+    warnings around backend overrides."""
+
+    def _qp(self, rng, n=8):
+        P = random_psd(rng, n)
+        q = rng.standard_normal(n)
+        return CanonicalQP.build(P, q, lb=np.zeros(n), ub=np.ones(n),
+                                 dtype=F64)
+
+    def test_auto_gates_on_vmem(self, rng):
+        """A problem whose Kinv + C footprint exceeds the VMEM budget
+        must not select the fused kernel under backend='auto'."""
+        import jax
+
+        from porqua_tpu.qp.admm import SolverParams as SP
+
+        n = 64
+        qp = self._qp(rng, n)
+        bytes_needed = (n * n + qp.m * n + 16 * (n + qp.m)) * 8
+        # Budget below the footprint: auto must take the XLA path even
+        # if the default backend were TPU. On CPU this is trivially the
+        # XLA path; the observable contract here is that the solve runs
+        # and converges with an arbitrarily small budget (i.e. the gate
+        # never leaves auto without a usable path).
+        small = SP(eps_abs=1e-8, eps_rel=1e-8, max_iter=10000,
+                   vmem_limit_mb=bytes_needed / 2**20 / 2)
+        sol = solve_qp(qp, small)
+        assert int(sol.status) == Status.SOLVED
+
+    def test_explicit_pallas_warns_over_budget(self, rng):
+        import warnings as _w
+
+        from porqua_tpu.qp.admm import SolverParams as SP
+
+        qp = self._qp(rng, n=16)
+        params = SP(backend="pallas", vmem_limit_mb=1e-4, max_iter=200)
+        with _w.catch_warnings(record=True) as rec:
+            _w.simplefilter("always")
+            solve_qp(qp, params)
+        msgs = [str(r.message) for r in rec]
+        assert any("VMEM footprint" in m for m in msgs), msgs
+        # Non-TPU host: interpret-mode warning fires too.
+        assert any("interpret mode" in m for m in msgs), msgs
+
+    def test_pallas_rho_clamp_warns_when_caller_tuned(self, rng):
+        import warnings as _w
+
+        import jax.numpy as jnp
+
+        from porqua_tpu.qp.admm import SolverParams as SP
+
+        n = 16
+        P = random_psd(rng, n).astype(np.float32)
+        q = rng.standard_normal(n).astype(np.float32)
+        qp = CanonicalQP.build(P, q, lb=np.zeros(n), ub=np.ones(n),
+                               dtype=jnp.float32)
+        params = SP(backend="pallas", rho_min=1e-9, rho_max=1e9,
+                    max_iter=200)
+        with _w.catch_warnings(record=True) as rec:
+            _w.simplefilter("always")
+            solve_qp(qp, params)
+        assert any("adaptive-rho clamp" in str(r.message) for r in rec)

@@ -69,6 +69,32 @@ class TestCheckpointManager:
             CheckpointManager.create(d, ["a", "b"], 1,
                                      SolverParams(eps_abs=1e-3))
 
+    def test_dtype_and_l1_mismatch_rejected(self, tmp_path):
+        """ADVICE: resuming with a different dtype (or a changed l1
+        configuration) must not silently mix chunks of one run."""
+        d = str(tmp_path / "run")
+        CheckpointManager.create(d, ["a", "b"], 1, SolverParams(),
+                                 dtype=jnp.float32)
+        with pytest.raises(ValueError, match="different run"):
+            CheckpointManager.create(d, ["a", "b"], 1, SolverParams(),
+                                     dtype=jnp.float64)
+        d2 = str(tmp_path / "run2")
+        CheckpointManager.create(d2, ["a", "b"], 1, SolverParams(),
+                                 dtype=jnp.float32, has_l1=False)
+        with pytest.raises(ValueError, match="different run"):
+            CheckpointManager.create(d2, ["a", "b"], 1, SolverParams(),
+                                     dtype=jnp.float32, has_l1=True)
+
+    def test_timestamp_rebdates_serializable(self, tmp_path):
+        """Non-string rebdates (pandas Timestamps) must be coerced, not
+        crash json.dump on first save."""
+        import pandas as pd
+
+        dates = list(pd.bdate_range("2020-01-01", periods=3))
+        mgr = CheckpointManager.create(
+            str(tmp_path / "run"), dates, 2, SolverParams())
+        assert all(isinstance(d, str) for d in mgr.rebdates)
+
 
 class TestRunBatchCheckpointed:
     def _make_service(self):

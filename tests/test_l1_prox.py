@@ -255,3 +255,31 @@ def test_prox_aware_polish_l1_dual_residual(rng):
         eps_abs=1e-9, eps_rel=1e-9, max_iter=20000, polish=True))
     np.testing.assert_allclose(
         np.asarray(sol.x), np.asarray(sol_l.x)[:n], atol=5e-7)
+
+
+def test_l1_duality_gap_valid(rng):
+    """ADVICE: with a native L1 term the reported duality gap must be a
+    real weak-duality bound (split the combined box dual into its L1
+    subgradient and box parts), not the plain-QP formula fed an invalid
+    dual. At a tightly solved point the gap must be ~0."""
+    P, q, C, l, u, lb, ub, x0, tc = _tracking_parts(rng)
+    n = len(q)
+    qp = CanonicalQP.build(P, q, C, l, u, lb, ub, dtype=np.float64)
+    sol = solve_qp(
+        qp, TIGHT,
+        l1_weight=jnp.full(n, tc, jnp.float64),
+        l1_center=jnp.asarray(x0),
+    )
+    assert bool(sol.found)
+    assert float(sol.duality_gap) < 1e-7, float(sol.duality_gap)
+
+    # And on an interior-kink solution (huge cost pins x at x0, where
+    # the subgradient is strictly inside [-w, w]) the gap must still be
+    # finite and tiny.
+    pinned = solve_qp(
+        qp, TIGHT,
+        l1_weight=jnp.full(n, 10.0, jnp.float64),
+        l1_center=jnp.asarray(x0),
+    )
+    assert np.isfinite(float(pinned.duality_gap))
+    assert float(pinned.duality_gap) < 1e-6, float(pinned.duality_gap)

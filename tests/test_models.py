@@ -59,3 +59,67 @@ def test_ltr_scores_rank_skilled_assets(rng):
     assert len(picked & true_top) >= 3
     # And the selection machinery narrowed the universe accordingly.
     assert len(bs.selection.selected) == 4
+
+
+def test_pairwise_loss_properties():
+    """The RankNet loss must be zero-gradient-free at perfect ordering,
+    penalize discordant pairs, and ignore masked slots."""
+    import jax.numpy as jnp
+
+    from porqua_tpu.models.ltr import pairwise_logistic_loss
+
+    labels = jnp.asarray([2.0, 1.0, 0.0])
+    mask = jnp.ones(3)
+    good = pairwise_logistic_loss(jnp.asarray([3.0, 0.0, -3.0]), labels, mask)
+    bad = pairwise_logistic_loss(jnp.asarray([-3.0, 0.0, 3.0]), labels, mask)
+    assert float(good) < 0.1 < float(bad)
+
+    # A masked slot with an absurd score must not change the loss.
+    with_pad = pairwise_logistic_loss(
+        jnp.asarray([3.0, 0.0, -3.0, 99.0]),
+        jnp.asarray([2.0, 1.0, 0.0, 5.0]),
+        jnp.asarray([1.0, 1.0, 1.0, 0.0]),
+    )
+    np.testing.assert_allclose(float(with_pad), float(good), rtol=1e-6)
+
+
+def test_pairwise_ranker_ndcg_above_chance(rng):
+    """VERDICT item 10: the JAX pairwise ranker must beat a chance
+    ranking by NDCG@k on held-out cross-sections with a planted
+    monotone signal."""
+    import jax.numpy as jnp
+
+    from porqua_tpu.models.lstm import ndcg
+    from porqua_tpu.models.ltr import PairwiseRanker
+
+    n_assets, n_feat, n_groups = 24, 5, 14
+    truth = rng.standard_normal(n_feat)
+
+    def make_group():
+        X = rng.standard_normal((n_assets, n_feat)).astype(np.float32)
+        signal = X @ truth
+        y = signal + rng.standard_normal(n_assets) * 0.3
+        ranks = y.argsort().argsort().astype(np.float32)  # 0..n-1 relevance
+        return X, ranks
+
+    groups = [make_group() for _ in range(n_groups)]
+    model = PairwiseRanker(epochs=200, seed=1).fit(groups[:10])
+
+    scores, rels = [], []
+    for X, r in groups[10:]:
+        scores.append(model.predict(X))
+        rels.append(r)
+    scores = np.stack(scores)
+    rels = np.stack(rels)
+    model_ndcg = float(np.mean(np.asarray(ndcg(
+        jnp.asarray(scores), jnp.asarray(rels), k=5))))
+
+    # Chance baseline: random score permutations on the same relevance.
+    chance = []
+    for _ in range(20):
+        perm = np.stack([rng.permutation(n_assets).astype(float)
+                         for _ in range(len(rels))])
+        chance.append(float(np.mean(np.asarray(ndcg(
+            jnp.asarray(perm), jnp.asarray(rels), k=5)))))
+    assert model_ndcg > np.mean(chance) + 3 * np.std(chance), (
+        model_ndcg, np.mean(chance), np.std(chance))

@@ -1,0 +1,163 @@
+"""Real-TPU evidence for the Pallas path (VERDICT round-1 item 5).
+
+The default suite runs the Pallas kernel in interpret mode on CPU; the
+f32 explicit-inverse segment with its rho clamp
+(``porqua_tpu/qp/admm.py``) is precisely the code whose behavior
+differs on hardware. These tests run it where it actually executes:
+
+    PORQUA_TPU_TESTS=1 python -m pytest tests -m tpu -v
+
+The session log is committed as ``TPU_TESTS_r{N}.txt`` each round.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from porqua_tpu.qp.admm import SolverParams
+from porqua_tpu.qp.canonical import CanonicalQP
+from porqua_tpu.qp.solve import Status, solve_qp
+
+pytestmark = pytest.mark.tpu
+
+
+def _tracking_qp(rng, n=128, T=160, dtype=jnp.float32):
+    X = (rng.standard_normal((T, n)) * 0.01).astype(np.float32)
+    w_true = rng.dirichlet(np.ones(n)).astype(np.float32)
+    y = X @ w_true + (rng.standard_normal(T) * 0.001).astype(np.float32)
+    P = 2.0 * X.T @ X
+    q = -2.0 * X.T @ y
+    return CanonicalQP.build(
+        P, q, C=np.ones((1, n)), l=np.ones(1), u=np.ones(1),
+        lb=np.zeros(n), ub=np.ones(n), dtype=dtype,
+    ), X, y
+
+
+def test_backend_is_tpu():
+    assert jax.default_backend() == "tpu", jax.devices()
+
+
+def test_pallas_kernel_parity_on_hardware(rng):
+    """Non-interpreted Pallas segment vs the XLA triangular-solve path,
+    both on the TPU chip: same problem, same optimum."""
+    qp, X, y = _tracking_qp(rng)
+    params = dict(eps_abs=1e-3, eps_rel=1e-3, max_iter=2000)
+    sol_xla = solve_qp(qp, SolverParams(backend="xla", **params))
+    sol_pal = solve_qp(qp, SolverParams(backend="pallas", **params))
+    assert int(sol_xla.status) == Status.SOLVED
+    assert int(sol_pal.status) == Status.SOLVED
+    np.testing.assert_allclose(
+        np.asarray(sol_pal.x), np.asarray(sol_xla.x), atol=5e-4)
+    te_x = float(np.sqrt(np.mean((X @ np.asarray(sol_xla.x) - y) ** 2)))
+    te_p = float(np.sqrt(np.mean((X @ np.asarray(sol_pal.x) - y) ** 2)))
+    assert abs(te_x - te_p) <= 1e-5, (te_x, te_p)
+
+
+def test_pallas_segment_matches_xla_iterations_on_hardware(rng):
+    """Kernel-level parity: one fused segment == check_interval plain
+    XLA iterations, run non-interpreted (the f32 explicit-inverse is the
+    part interpret mode cannot vouch for)."""
+    from jax.scipy.linalg import cho_factor, cho_solve
+
+    from porqua_tpu.ops.admm_kernel import admm_segment
+    from porqua_tpu.qp.ruiz import equilibrate
+
+    qp, _, _ = _tracking_qp(rng, n=96, T=128)
+    scaled, scaling = equilibrate(qp, iters=10)
+    n, m = scaled.n, scaled.m
+    dtype = scaled.P.dtype
+    rho = jnp.full((m,), 100.0, dtype)  # budget row is an equality: 1e3 * 0.1
+    rho_b = jnp.full((n,), 0.1, dtype)
+    sigma, alpha, iters = 1e-6, 1.6, 25
+
+    K = (scaled.P + sigma * jnp.eye(n, dtype=dtype)
+         + (scaled.C.T * rho) @ scaled.C + jnp.diag(rho_b))
+    chol = cho_factor(K)
+    Kinv = cho_solve(chol, jnp.eye(n, dtype=dtype))
+
+    x = jnp.zeros(n, dtype)
+    z = jnp.zeros(m, dtype)
+    w = jnp.clip(x, scaled.lb, scaled.ub)
+    y = jnp.zeros(m, dtype)
+    mu = jnp.zeros(n, dtype)
+    zeros = jnp.zeros(n, dtype)
+
+    out = admm_segment(
+        Kinv, scaled.C, scaled.q, scaled.l, scaled.u, scaled.lb, scaled.ub,
+        rho, rho_b, zeros, zeros, x, z, w, y, mu,
+        sigma=sigma, alpha=alpha, n_iters=iters, interpret=False,
+    )
+
+    # Plain XLA reference iterations (same explicit-inverse linear step,
+    # so the comparison isolates the kernel, not factorization error).
+    def one(carry, _):
+        x, z, w, y, mu = carry
+        rhs = (sigma * x - scaled.q + scaled.C.T @ (rho * z - y)
+               + (rho_b * w - mu))
+        xt = Kinv @ rhs
+        zt = scaled.C @ xt
+        x_new = alpha * xt + (1 - alpha) * x
+        z_pre = alpha * zt + (1 - alpha) * z
+        z_new = jnp.clip(z_pre + y / rho, scaled.l, scaled.u)
+        y_new = y + rho * (z_pre - z_new)
+        w_pre = alpha * xt + (1 - alpha) * w
+        w_new = jnp.clip(w_pre + mu / rho_b, scaled.lb, scaled.ub)
+        mu_new = mu + rho_b * (w_pre - w_new)
+        return (x_new, z_new, w_new, y_new, mu_new), None
+
+    (x_r, z_r, w_r, y_r, mu_r), _ = jax.lax.scan(
+        one, (x, z, w, y, mu), None, length=iters)
+
+    for got, ref, tol in ((out[0], x_r, 2e-5), (out[2], w_r, 2e-5),
+                          (out[4], mu_r, 2e-4)):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), atol=tol)
+
+
+def test_rho_clamp_range_converges_on_hardware(rng):
+    """The documented [1e-3, 1e2] rho clamp must keep the f32 explicit
+    inverse usable across its whole range on the real MXU."""
+    qp, _, _ = _tracking_qp(rng, n=128, T=160)
+    for rho0 in (1e-3, 1e-1, 1e2):
+        sol = solve_qp(qp, SolverParams(
+            backend="pallas", rho0=rho0, adaptive_rho=False,
+            eps_abs=1e-3, eps_rel=1e-3, max_iter=6000))
+        assert int(sol.status) == Status.SOLVED, rho0
+        assert float(sol.prim_res) < 1e-2
+
+
+def test_northstar_shard_matched_tracking_error(rng):
+    """A 16-date slice of the north-star shape (500 assets, window 252)
+    solved on-chip: every date solves, and the f32+polish tracking error
+    matches the f64 CPU-grade optimum within noise (the 'matched
+    tracking error' acceptance bar)."""
+    from porqua_tpu.qp.solve import SolverParams as SP
+    from porqua_tpu.tracking import synthetic_universe_np, tracking_step_jit
+
+    Xs_np, ys_np = synthetic_universe_np(
+        seed=7, n_dates=16, window=252, n_assets=500)
+    out = tracking_step_jit(
+        jnp.asarray(Xs_np), jnp.asarray(ys_np),
+        SP(eps_abs=1e-3, eps_rel=1e-3, max_iter=2000))
+    status = np.asarray(out.status)
+    assert int((status == Status.SOLVED).sum()) == 16, status
+
+    # Independent f64 host reference on the first 4 dates (scipy SLSQP
+    # is too slow at n=500; use the analytic equality-constrained
+    # optimum projected by our own f64 numpy ADMM from bench.py).
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "benchmod", "/root/repo/bench.py")
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    for i in range(4):
+        X, y = Xs_np[i].astype(np.float64), ys_np[i].astype(np.float64)
+        P = 2.0 * X.T @ X
+        q = -2.0 * X.T @ y
+        x_ref, _ = bench.admm_cpu(P, q, 0.0, 1.0, eps=1e-7, max_iter=20000)
+        te_ref = float(np.sqrt(np.mean((X @ x_ref - y) ** 2)))
+        te_dev = float(out.tracking_error[i])
+        assert te_dev <= te_ref * 1.02 + 1e-6, (te_dev, te_ref)
