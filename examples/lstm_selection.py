@@ -42,6 +42,28 @@ def main():
     top = np.argsort(-pred[-1])[:10]
     print("top-10 assets on the last day:", list(returns.columns[top]))
 
+    # Quality comparison against the reference's shipped trained model
+    # (model/lstm_msci.keras, evaluated through the same NDCG harness —
+    # the lstm.ipynb cell-10 workflow, no tensorflow required).
+    import os
+
+    ref_path = "/root/reference/model/lstm_msci.keras"
+    if os.path.exists(ref_path):
+        from porqua_tpu.models.lstm import (
+            load_reference_lstm, reference_lstm_windows)
+
+        ref_model = load_reference_lstm(ref_path)
+        X_ref, y_ref = reference_lstm_windows(
+            returns.values.astype(np.float32), window)
+        X_ref, y_ref = X_ref[-test_size:], y_ref[-test_size:]
+        ref_pred = ref_model.predict(X_ref)
+        rel_ref = np.argsort(np.argsort(y_ref, axis=1), axis=1).astype(float)
+        for k in (5, 10):
+            ours = float(np.mean(np.asarray(ndcg(pred, rel, k=k))))
+            theirs = float(np.mean(np.asarray(ndcg(ref_pred, rel_ref, k=k))))
+            print(f"NDCG@{k}: this model {ours:.3f} vs "
+                  f"reference saved model {theirs:.3f}")
+
 
 if __name__ == "__main__":
     main()

@@ -11,6 +11,16 @@ factor crosses HBM once per ~25 iterations instead of once per
 iteration. With the batch as the grid axis, Pallas double-buffers the
 next problem's DMA behind the current problem's iteration loop for free.
 
+Status (round-2 measurement): the kernel is **opt-in**
+(``backend="pallas"``), not the default. Applying the KKT operator
+through an explicit f32 inverse carries ``cond(K)*eps`` error, which
+costs extra ADMM segments on ill-conditioned problems (measured 100 vs
+25 iterations on the north-star batch) — more than the HBM savings
+repay. The default path keeps the factor-reuse idea at chol-level
+accuracy by inverting only the *triangular factor* once per segment
+(``SolverParams.linsolve="trinv"``, error ``sqrt(cond(K))*eps``) and
+running the iterations as dense matvecs in stock XLA.
+
 This replaces the hot loop of the external C solvers the reference
 dispatches to through ``qpsolvers.solve_problem`` (reference
 ``src/qp_problems.py:211`` — OSQP's sparse LDL backsolve per iteration);

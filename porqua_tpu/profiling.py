@@ -193,21 +193,22 @@ def admm_flop_model(n: int, m: int, window: int, iters: float,
                     check_interval: int = 25, scaling_iters: int = 10,
                     pallas: bool = False, polish_passes: int = 3,
                     polish_refine_steps: int = 3,
-                    l1_kkt_solves: int = 1) -> Dict[str, float]:
+                    l1_kkt_solves: int = 1,
+                    linsolve: str = "trinv") -> Dict[str, float]:
     """Analytic FLOP + HBM-byte count for one batched tracking solve.
 
     Mirrors the actual program in :mod:`porqua_tpu.tracking` /
     :mod:`porqua_tpu.qp.admm`: Gram assembly, Ruiz equilibration, per-
-    segment KKT (re)factorization (+ explicit inverse on the Pallas
-    path), the iteration loop, per-segment residual checks, and the
-    active-set polish (full-KKT LU + refinement). All counts are per
-    problem, multiplied by ``n_dates`` at the end. ``iters`` is the
-    average iteration count actually executed (device-reported).
+    segment KKT (re)factorization (+ the explicit inverse on the
+    Pallas/"inverse" paths, or the triangular-factor inverse for
+    ``linsolve="trinv"``), the iteration loop, per-segment residual
+    checks, and the reduced-Schur active-set polish (n x n Cholesky +
+    refinement sweeps). All counts are per problem, multiplied by
+    ``n_dates`` at the end. ``iters`` is the average iteration count
+    actually executed (device-reported).
     """
     T = window
     segs = (iters / check_interval) if segments is None else segments
-    N_kkt = 2 * n + m  # polish KKT size
-
     flops = {}
     flops["gram"] = 2.0 * T * n * n + 4.0 * T * n
     flops["ruiz"] = scaling_iters * 4.0 * (m * n + n * n)
@@ -217,14 +218,22 @@ def admm_flop_model(n: int, m: int, window: int, iters: float,
         # refinement (two further n^3 HIGHEST matmuls, admm.py
         # refined_inverse).
         fact += 2.0 * (n ** 3) + 4.0 * (n ** 3)
+    elif linsolve == "trinv":
+        fact += (n ** 3)  # explicit triangular-factor inverse (n-RHS trsm)
+    elif linsolve == "inverse":
+        fact += 2.0 * (n ** 3) + 4.0 * (n ** 3)
     flops["factorize"] = segs * fact
+    # Two triangular applications per iteration on every path: trsm
+    # pair (chol) or dense matvec pair (trinv/inverse) — same FLOPs.
     per_iter = (2.0 * n * n) + 4.0 * m * n + 15.0 * n
     flops["iterate"] = iters * per_iter
     flops["residual_checks"] = segs * (2.0 * n * n + 4.0 * m * n)
-    # Each polish pass runs `l1_kkt_solves` full-KKT LU solves (2 when a
-    # live L1 term triggers the kink-reclassification re-solve).
+    # Each polish pass runs `l1_kkt_solves` reduced-Schur solves (2 when
+    # a live L1 term triggers the kink-reclassification re-solve): an
+    # n x n Cholesky + (refine+1) solve/matvec sweeps.
     flops["polish"] = polish_passes * l1_kkt_solves * (
-        2.0 * (N_kkt ** 3) / 3.0 + (polish_refine_steps + 1) * 4.0 * N_kkt ** 2
+        (n ** 3) / 3.0 + 2.0 * m * n * n
+        + (polish_refine_steps + 1) * 8.0 * n * n
     )
     flops["tracking_error"] = 2.0 * T * n
 
@@ -240,8 +249,8 @@ def admm_flop_model(n: int, m: int, window: int, iters: float,
     else:
         bytes_["iterate"] = iters * item * 2.0 * (n * n) + iters * item * 2 * m * n
         bytes_["factorize"] = segs * item * 4.0 * n * n
-    bytes_["polish"] = polish_passes * item * (
-        3.0 * N_kkt ** 2 + polish_refine_steps * 2.0 * N_kkt ** 2
+    bytes_["polish"] = polish_passes * l1_kkt_solves * item * (
+        3.0 * n * n + (polish_refine_steps + 1) * 2.0 * n * n
     )
 
     total_flops = float(sum(flops.values())) * n_dates

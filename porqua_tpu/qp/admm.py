@@ -74,15 +74,25 @@ class SolverParams:
 
     max_iter: int = 4000
     check_interval: int = 25
+    # "auto" == "xla" everywhere: the fused Pallas kernel is opt-in
+    # only (its explicit f32 inverse costs iterations — see the backend
+    # selection note in admm_solve); "pallas" forces the fused segment.
     backend: str = "auto"  # "auto" | "xla" | "pallas"
     # Linear-solve strategy inside a segment for the XLA backend:
     # "chol"    — cho_solve (two triangular solves) per iteration;
-    #             most accurate, but triangular solves are the slowest
-    #             primitive on the MXU.
-    # "inverse" — explicit KKT inverse (one Newton refinement recovers
-    #             the f32 accuracy the plain inverse loses), then each
-    #             iteration is a single batched matvec: pure MXU work.
-    # "auto"    — "inverse" on TPU, "chol" elsewhere.
+    #             most accurate, but a one-RHS trsm is the slowest
+    #             primitive on the MXU (measured ~12 ms/iteration for
+    #             the 252 x 500 north-star batch, ~20x off roofline).
+    # "trinv"   — invert the Cholesky factor L once per segment, then
+    #             each iteration applies K^-1 = L^-T L^-1 as two dense
+    #             matvecs: pure MXU/HBM-streaming work, with solve
+    #             error ~cond(L)*eps = sqrt(cond(K))*eps — measured to
+    #             preserve the chol path's iteration counts where the
+    #             full explicit K^-1 (cond(K)*eps) does not.
+    # "inverse" — explicit KKT inverse with one Newton refinement;
+    #             cheapest per iteration but the f32 error budget costs
+    #             extra segments on ill-conditioned problems.
+    # "auto"    — "trinv" on TPU, "chol" elsewhere.
     linsolve: str = "auto"
     # VMEM budget for the fused Pallas segment (Kinv + C + state vectors
     # must all be core-resident; ~16 MB/core on v5e, leave headroom).
@@ -317,10 +327,13 @@ def admm_solve(qp: CanonicalQP,
         * jnp.dtype(dtype).itemsize
     )
     fits_vmem = vmem_bytes <= params.vmem_limit_mb * 2**20
-    use_pallas = params.backend == "pallas" or (
-        params.backend == "auto" and jax.default_backend() == "tpu"
-        and fits_vmem
-    )
+    # The fused kernel is opt-in only: its explicit f32 K^-1 costs extra
+    # segments on ill-conditioned problems (measured 100 vs 25
+    # iterations on the north-star batch), so backend="auto" takes the
+    # XLA path with linsolve="trinv" — which keeps the factor-reuse idea
+    # (one inversion per segment, matvec iterations) at chol-level
+    # accuracy.
+    use_pallas = params.backend == "pallas"
     if params.backend == "pallas":
         if not fits_vmem:
             warnings.warn(
@@ -337,18 +350,20 @@ def admm_solve(qp: CanonicalQP,
                 "path); use backend='auto' unless this is a parity test.",
                 stacklevel=2,
             )
-    use_inverse = use_pallas or params.linsolve == "inverse" or (
-        params.linsolve == "auto" and jax.default_backend() == "tpu"
-    )
+    linsolve = params.linsolve
+    if linsolve == "auto":
+        linsolve = "trinv" if jax.default_backend() == "tpu" else "chol"
+    use_inverse = use_pallas or linsolve in ("inverse", "trinv")
 
-    # The inverse-based linear solve (Pallas kernel and linsolve=
-    # "inverse") loses accuracy with cond(K) even after Newton
-    # refinement; K carries rho_eq_scale * rho on equality rows, so in
-    # f32 the adaptive-rho clamp must stay inside what the refined
-    # inverse can represent. [1e-3, 1e2] keeps cond(K) within f32 range
-    # on Ruiz-equilibrated problems (OSQP's wider f64 clamp makes the
-    # inverse diverge on TPU); the triangular-solve path and any f64
-    # solve keep the caller's clamp.
+    # Every explicit-inverse linear solve — the Pallas kernel,
+    # linsolve="inverse", and linsolve="trinv" (the TPU default) —
+    # loses accuracy with conditioning; K carries rho_eq_scale * rho on
+    # equality rows, so in f32 the adaptive-rho clamp must stay inside
+    # what the inverted factor can represent. [1e-3, 1e2] keeps cond(K)
+    # within f32 range on Ruiz-equilibrated problems (OSQP's wider f64
+    # clamp makes the inverse diverge on TPU). Only the per-iteration
+    # cho_solve path (linsolve="chol") and any f64 solve keep the
+    # caller's clamp.
     if use_inverse and jnp.dtype(dtype) == jnp.float32:
         rho_lo = max(params.rho_min, 1e-3)
         rho_hi = min(params.rho_max, 1e2)
@@ -393,9 +408,9 @@ def admm_solve(qp: CanonicalQP,
             + (qp.C.T * rho) @ qp.C
             + jnp.diag(rho_b)
         )
-        chol = cho_factor(K)
 
         if use_pallas:
+            chol = cho_factor(K)
             # Fused segment with the explicit KKT inverse VMEM-resident:
             # the extra n^3 for the inverse amortizes over check_interval
             # iterations that would otherwise each re-read the factor
@@ -412,9 +427,22 @@ def admm_solve(qp: CanonicalQP,
                 interpret=jax.default_backend() != "tpu",
             )
         else:
-            if use_inverse:
-                Kinv = refined_inverse(K, chol)
-                hp = jax.lax.Precision.HIGHEST
+            hp = jax.lax.Precision.HIGHEST
+            if linsolve == "trinv":
+                # Invert the triangular factor once; each iteration is
+                # then K^-1 r = L^-T (L^-1 r): two dense matvecs. Error
+                # per solve ~cond(L)*eps = sqrt(cond(K))*eps — an order
+                # better than the explicit K^-1, which is what keeps
+                # the chol path's convergence rate.
+                from jax.scipy.linalg import solve_triangular
+
+                L = jnp.linalg.cholesky(K)
+                Linv = solve_triangular(
+                    L, jnp.eye(n, dtype=dtype), lower=True)
+                solve = lambda rhs: jnp.dot(
+                    jnp.dot(Linv, rhs, precision=hp), Linv, precision=hp)
+            elif linsolve == "inverse":
+                Kinv = refined_inverse(K, cho_factor(K))
                 # Apply as rhs @ Kinv (the transpose side), matching the
                 # Pallas kernel: the one-sided Newton refinement leaves
                 # the transpose application markedly more accurate in
@@ -423,6 +451,7 @@ def admm_solve(qp: CanonicalQP,
                 # arithmetic so the two sides agree mathematically.
                 solve = lambda rhs: jnp.dot(rhs, Kinv, precision=hp)
             else:
+                chol = cho_factor(K)
                 solve = lambda rhs: cho_solve(chol, rhs)
 
             def body(_, carry):

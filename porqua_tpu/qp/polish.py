@@ -10,18 +10,22 @@ converged duals/slacks, then solve the equality-constrained KKT system
      [C_act,   -dI,     0    ],   @  [nu]  =  [bound_act ]
      [I_act,   0,       -dI  ]]      [tau]    [boundb_act]
 
-with inactive dual rows replaced by ``nu_i = 0`` so the shape stays
-static. The system is solved by batched LU with a few steps of
-iterative refinement (recovers near-working-precision accuracy in f32).
-The polished point is accepted only where it improves the residuals —
-per problem, via ``jnp.where`` — so polish can never hurt.
+with inactive dual rows pinned to zero so the shape stays static. The
+dual rows are eliminated analytically, leaving the SPD Schur complement
+``M = P + dI + (1/d)(C_a' C_a + I_a)`` solved by an n x n Cholesky —
+~16x fewer FLOPs than LU on the full (2n+m) system and far better MXU
+tiling — with a few refinement steps against the *unperturbed* KKT
+residuals (so the fixed point is the true active-set solution, not the
+d-regularized one). The polished point is accepted only where it
+improves the residuals — per problem, via ``jnp.where`` — so polish can
+never hurt.
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-from jax.scipy.linalg import lu_factor, lu_solve
+from jax.scipy.linalg import cho_factor, cho_solve
 
 from porqua_tpu.qp.admm import SolverParams, _residuals
 from porqua_tpu.qp.canonical import CanonicalQP
@@ -118,38 +122,54 @@ def polish(qp: CanonicalQP,
     bound_B = jnp.where(jnp.isfinite(bound_B), bound_B, 0.0)
 
     eye_n = jnp.eye(n, dtype=dtype)
+    # In f32 the (1/delta)-weighted Schur complement must stay within
+    # what a Cholesky + refinement can represent; sqrt(machine eps) is
+    # the classic regularization compromise (f64 keeps the caller's
+    # tighter delta).
+    delta = jnp.maximum(
+        delta, jnp.sqrt(jnp.asarray(jnp.finfo(dtype).eps, dtype)))
+    inv_d = 1.0 / delta
 
     def kkt_solve(at_kink_i, sub_sign_i):
-        """Equality-KKT solve for one active-set/sign hypothesis."""
+        """Equality-KKT solve for one active-set/sign hypothesis.
+
+        Instead of the full (2n+m) indefinite KKT LU, eliminate the
+        dual rows: with actives a_C/a_B the perturbed system reduces to
+        the SPD Schur complement
+
+            M = P + delta I + (1/delta)(C' diag(a_C) C + diag(a_B))
+
+        solved by an n x n Cholesky — ~16x fewer FLOPs than the LU and
+        a primitive the MXU tiles well. Refinement iterates against the
+        UNPERTURBED KKT residuals (r1, r2, r3 below), so the fixed
+        point is the true active-set solution, not the
+        delta-regularized one (same scheme as OSQP's polish, reduced).
+        """
         aB_i = (act_low_B | act_up_B | eq_B | at_kink_i).astype(dtype)
         aC_i = act_C.astype(dtype)
         bound_B_i = jnp.where(
             at_kink_i, jnp.clip(l1c, qp.lb, qp.ub), bound_B)
         q_eff_i = qp.q + (l1_weight * sub_sign_i if has_l1 else 0.0)
-        # KKT blocks; inactive dual rows become identity rows pinning
-        # the dual to 0.
-        top = jnp.concatenate([qp.P + delta * eye_n, qp.C.T, eye_n], axis=1)
-        midC = jnp.concatenate(
-            [aC_i[:, None] * qp.C,
-             jnp.diag(-delta * aC_i + (1.0 - aC_i)),
-             jnp.zeros((m, n), dtype)],
-            axis=1,
+        bC = aC_i * bound_C
+        bB = aB_i * bound_B_i
+
+        M = (
+            qp.P + delta * eye_n
+            + inv_d * ((qp.C.T * aC_i) @ qp.C + jnp.diag(aB_i))
         )
-        midB = jnp.concatenate(
-            [jnp.diag(aB_i),
-             jnp.zeros((n, m), dtype),
-             jnp.diag(-delta * aB_i + (1.0 - aB_i))],
-            axis=1,
-        )
-        KKT = jnp.concatenate([top, midC, midB], axis=0)
-        rhs = jnp.concatenate(
-            [-q_eff_i, aC_i * bound_C, aB_i * bound_B_i])
-        lu = lu_factor(KKT)
-        sol = lu_solve(lu, rhs)
+        cholM = cho_factor(M)
+        x_i = cho_solve(cholM, -q_eff_i + inv_d * (qp.C.T @ bC + bB))
+        nu = aC_i * (qp.C @ x_i - bound_C) * inv_d
+        tau = aB_i * (x_i - bound_B_i) * inv_d
         for _ in range(params.polish_refine_steps):
-            resid = rhs - KKT @ sol
-            sol = sol + lu_solve(lu, resid)
-        return sol[:n], sol[n:n + m], sol[n + m:]
+            r1 = -q_eff_i - (qp.P @ x_i + qp.C.T @ nu + tau)
+            r2 = aC_i * (bound_C - qp.C @ x_i)
+            r3 = aB_i * (bound_B_i - x_i)
+            dx = cho_solve(cholM, r1 + inv_d * (qp.C.T @ r2 + r3))
+            nu = nu + aC_i * (qp.C @ dx - r2) * inv_d
+            tau = tau + aB_i * (dx - r3) * inv_d
+            x_i = x_i + dx
+        return x_i, nu, tau
 
     x_p, y_p, tau_p = kkt_solve(at_kink, sub_sign)
 
