@@ -193,21 +193,13 @@ def device_child(platform: str) -> None:
     compile_s = time.perf_counter() - t0
     log(f"compile+first run: {compile_s:.2f}s")
 
-    # Measurement discipline (the TPU is reached through a tunnel whose
-    # async dispatch can mis-attribute a run's device time to a later
-    # call): perturb the input each run so no layer can alias repeated
-    # executions, device_get a small output to force true completion,
-    # and discard the first post-compile run.
-    runs = []
-    for i in range(4):
-        Xs_i = Xs + jnp.float32(1e-7 * (i + 1))
-        jax.block_until_ready(Xs_i)
-        t0 = time.perf_counter()
-        out = tracking_step_jit(Xs_i, ys, params)
-        np.asarray(out.tracking_error)
-        runs.append(time.perf_counter() - t0)
-    runs = runs[1:]
-    dev_s = sorted(runs)[len(runs) // 2]
+    # Measurement discipline (perturbed inputs, device_get completion,
+    # first run discarded, median) — shared helper, see its docstring
+    # for why block_until_ready alone is not trustworthy here.
+    from porqua_tpu.profiling import measure_device
+
+    dev_s, runs, out = measure_device(
+        lambda X: tracking_step_jit(X, ys, params), Xs)
     solved = int(np.sum(np.asarray(out.status) == 1))
     te_dev = float(np.median(np.asarray(out.tracking_error)))
     iters_med = float(np.median(np.asarray(out.iters)))

@@ -182,3 +182,50 @@ class TestReferenceModelParity:
         ref_ndcg = float(np.mean(np.asarray(ndcg(pred, rel, k=10))))
         assert 0.0 < ref_ndcg <= 1.0
         print(f"reference saved-model NDCG@10 on MSCI tail: {ref_ndcg:.3f}")
+
+
+REPO_ARTIFACT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "model", "lstm_msci_flax.msgpack")
+
+
+@pytest.mark.skipif(
+    not (os.path.exists(REPO_ARTIFACT)
+         and os.path.isdir("/root/reference/data/")),
+    reason="shipped artifact or reference data missing",
+)
+def test_shipped_artifact_loads_and_ranks():
+    """The repo ships a trained ranker (model/lstm_msci_flax.msgpack,
+    the analog of the reference's model/lstm_msci.keras). It must load
+    into a fresh module and rank the MSCI held-out tail above chance."""
+    from porqua_tpu.data_loader import load_data_msci
+    from porqua_tpu.models.lstm import (
+        LSTMRanker, TrainedLSTM, make_windows)
+
+    data = load_data_msci(path="/root/reference/data/")
+    returns = data["return_series"].tail(400)
+    X, y = make_windows(returns.values, 100)
+    X, y = X[-50:], y[-50:]
+
+    module = LSTMRanker(n_assets=returns.shape[1], hidden=32)
+    import jax
+
+    params = module.init(jax.random.PRNGKey(0), X[:1].astype(np.float32),
+                         deterministic=True)["params"]
+    model = TrainedLSTM(module=module, params=params,
+                        loss_history=np.zeros(0))
+    model.load_params(REPO_ARTIFACT)
+
+    pred = model.predict(X)
+    rel = np.argsort(np.argsort(y, axis=1), axis=1).astype(float)
+    got = float(np.mean(np.asarray(ndcg(pred, rel, k=10))))
+    # Chance NDCG@10 for 24 graded items is ~0.56 with small variance;
+    # the shipped artifact scores ~0.63 on this tail.
+    rng = np.random.default_rng(0)
+    chance = [
+        float(np.mean(np.asarray(ndcg(
+            np.stack([rng.permutation(24).astype(float)
+                      for _ in range(len(rel))]), rel, k=10))))
+        for _ in range(10)
+    ]
+    assert got > np.mean(chance) + 2 * np.std(chance), (got, np.mean(chance))
