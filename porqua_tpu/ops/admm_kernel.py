@@ -55,8 +55,17 @@ def _segment_kernel(Kinv_ref, C_ref, q_ref, l_ref, u_ref, lb_ref, ub_ref,
                     x_ref, z_ref, w_ref, y_ref, mu_ref,
                     x_out, z_out, w_out, y_out, mu_out,
                     dx_out, dy_out, dmu_out,
-                    *, sigma: float, alpha: float, n_iters: int):
-    """One ADMM segment (``n_iters`` iterations) for one problem, all in VMEM."""
+                    *, sigma: float, alpha: float, n_iters: int,
+                    triangular: bool = False):
+    """One ADMM segment (``n_iters`` iterations) for one problem, all in VMEM.
+
+    With ``triangular=True`` the resident matrix is the inverse
+    Cholesky factor ``L^-1`` and the linear step applies
+    ``K^-1 = L^-T L^-1`` as two dense matvecs — the accuracy story of
+    ``SolverParams.linsolve="trinv"`` (error ``sqrt(cond(K))*eps``
+    instead of the full inverse's ``cond(K)*eps``) with the kernel's
+    VMEM residency.
+    """
     dtype = x_ref.dtype
     Kinv = Kinv_ref[:]
     C = C_ref[:]
@@ -87,9 +96,21 @@ def _segment_kernel(Kinv_ref, C_ref, q_ref, l_ref, u_ref, lb_ref, ub_ref,
                       precision=jax.lax.Precision.HIGHEST)
             + (rho_b * w - mu)
         )
-        # K is symmetric, so Kinv is too: x~ = rhs @ Kinv == Kinv @ rhs.
-        xt = jnp.dot(rhs, Kinv, preferred_element_type=dtype,
-                     precision=jax.lax.Precision.HIGHEST)
+        if triangular:
+            # Kinv holds L^-1: xt = L^-T (L^-1 rhs). Row-vector form:
+            # u = rhs @ L^-T (contract rhs lanes with L^-1's lanes),
+            # then xt = u @ L^-1.
+            u_row = jax.lax.dot_general(
+                rhs, Kinv, (((1,), (1,)), ((), ())),
+                preferred_element_type=dtype,
+                precision=jax.lax.Precision.HIGHEST,
+            )
+            xt = jnp.dot(u_row, Kinv, preferred_element_type=dtype,
+                         precision=jax.lax.Precision.HIGHEST)
+        else:
+            # K is symmetric, so Kinv is too: x~ = rhs @ Kinv == Kinv @ rhs.
+            xt = jnp.dot(rhs, Kinv, preferred_element_type=dtype,
+                         precision=jax.lax.Precision.HIGHEST)
         # zt = C @ xt, contracting xt's lane axis with C's column axis.
         zt = jax.lax.dot_general(
             xt, C, (((1,), (1,)), ((), ())), preferred_element_type=dtype,
@@ -123,7 +144,8 @@ def _segment_kernel(Kinv_ref, C_ref, q_ref, l_ref, u_ref, lb_ref, ub_ref,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("sigma", "alpha", "n_iters", "interpret")
+    jax.jit,
+    static_argnames=("sigma", "alpha", "n_iters", "interpret", "triangular"),
 )
 def admm_segment(Kinv: jax.Array,
                  C: jax.Array,
@@ -145,7 +167,8 @@ def admm_segment(Kinv: jax.Array,
                  sigma: float,
                  alpha: float,
                  n_iters: int,
-                 interpret: bool = False) -> Tuple[jax.Array, ...]:
+                 interpret: bool = False,
+                 triangular: bool = False) -> Tuple[jax.Array, ...]:
     """Run ``n_iters`` fused ADMM iterations on one problem.
 
     Inputs are the *scaled* problem data for a single QP (no batch axis —
@@ -192,7 +215,8 @@ def admm_segment(Kinv: jax.Array,
     vec_m = jax.ShapeDtypeStruct((1, m_p), dtype)
     out = pl.pallas_call(
         functools.partial(
-            _segment_kernel, sigma=sigma, alpha=alpha, n_iters=n_iters
+            _segment_kernel, sigma=sigma, alpha=alpha, n_iters=n_iters,
+            triangular=triangular,
         ),
         out_shape=(vec_n, vec_m, vec_n, vec_m, vec_n, vec_n, vec_m, vec_n),
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * len(args),

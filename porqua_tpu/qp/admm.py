@@ -327,12 +327,13 @@ def admm_solve(qp: CanonicalQP,
         * jnp.dtype(dtype).itemsize
     )
     fits_vmem = vmem_bytes <= params.vmem_limit_mb * 2**20
-    # The fused kernel is opt-in only: its explicit f32 K^-1 costs extra
-    # segments on ill-conditioned problems (measured 100 vs 25
-    # iterations on the north-star batch), so backend="auto" takes the
-    # XLA path with linsolve="trinv" — which keeps the factor-reuse idea
-    # (one inversion per segment, matvec iterations) at chol-level
-    # accuracy.
+    # The fused kernel is opt-in only. Its trinv mode matches the XLA
+    # path's accuracy, but measured wall-clock is at parity on the
+    # north-star batch (the iteration stage is latency-bound there, not
+    # HBM-bound — BASELINE.md), so backend="auto" keeps the simpler XLA
+    # path; the kernel's residency advantage grows with n and iteration
+    # count. (Its non-trinv mode also carries the explicit-f32-K^-1
+    # accuracy penalty: measured 100 vs 25 iterations.)
     use_pallas = params.backend == "pallas"
     if params.backend == "pallas":
         if not fits_vmem:
@@ -400,6 +401,18 @@ def admm_solve(qp: CanonicalQP,
             Kinv, 2.0 * eye - jnp.dot(K, Kinv, precision=hp), precision=hp
         )
 
+    def triangular_inverse(K):
+        """L^-1 for K = L L^T. Applying K^-1 = L^-T L^-1 as two dense
+        matvecs costs ~cond(L)*eps = sqrt(cond(K))*eps per solve — an
+        order better than the explicit K^-1, which is what keeps the
+        chol path's convergence rate. One copy shared by the XLA and
+        Pallas branches so the two cannot drift (bit-parity is pinned
+        by TestTriangularKernel)."""
+        from jax.scipy.linalg import solve_triangular
+
+        L = jnp.linalg.cholesky(K)
+        return solve_triangular(L, jnp.eye(n, dtype=dtype), lower=True)
+
     def segment(state: ADMMState) -> ADMMState:
         rho, rho_b = _rho_vectors(qp, state.rho_bar, params)
         K = (
@@ -410,35 +423,33 @@ def admm_solve(qp: CanonicalQP,
         )
 
         if use_pallas:
-            chol = cho_factor(K)
-            # Fused segment with the explicit KKT inverse VMEM-resident:
-            # the extra n^3 for the inverse amortizes over check_interval
-            # iterations that would otherwise each re-read the factor
-            # from HBM (see porqua_tpu.ops.admm_kernel).
+            # Fused segment with the linear-solve operator VMEM-resident
+            # across the whole check_interval (see
+            # porqua_tpu.ops.admm_kernel). With linsolve="trinv" (the
+            # TPU default) the resident matrix is L^-1 applied twice —
+            # the same accuracy story as the XLA trinv path; otherwise
+            # the refined explicit K^-1 applied once.
             from porqua_tpu.ops.admm_kernel import admm_segment
 
-            Kinv = refined_inverse(K, chol)
+            if linsolve == "trinv":
+                op = triangular_inverse(K)
+                triangular = True
+            else:
+                op = refined_inverse(K, cho_factor(K))
+                triangular = False
             x, z, w, y, mu, dx, dy, dmu = admm_segment(
-                Kinv, qp.C, qp.q, qp.l, qp.u, qp.lb, qp.ub, rho, rho_b,
+                op, qp.C, qp.q, qp.l, qp.u, qp.lb, qp.ub, rho, rho_b,
                 l1w, l1c,
                 state.x, state.z, state.w, state.y, state.mu,
                 sigma=params.sigma, alpha=params.alpha,
                 n_iters=params.check_interval,
                 interpret=jax.default_backend() != "tpu",
+                triangular=triangular,
             )
         else:
             hp = jax.lax.Precision.HIGHEST
             if linsolve == "trinv":
-                # Invert the triangular factor once; each iteration is
-                # then K^-1 r = L^-T (L^-1 r): two dense matvecs. Error
-                # per solve ~cond(L)*eps = sqrt(cond(K))*eps — an order
-                # better than the explicit K^-1, which is what keeps
-                # the chol path's convergence rate.
-                from jax.scipy.linalg import solve_triangular
-
-                L = jnp.linalg.cholesky(K)
-                Linv = solve_triangular(
-                    L, jnp.eye(n, dtype=dtype), lower=True)
+                Linv = triangular_inverse(K)
                 solve = lambda rhs: jnp.dot(
                     jnp.dot(Linv, rhs, precision=hp), Linv, precision=hp)
             elif linsolve == "inverse":
