@@ -5,9 +5,12 @@ that double as the only documentation; these scripts are their runnable
 equivalents. Each script prints what it computes — run any of them with
 ``python examples/<name>.py``.
 
-Set ``PORQUA_PLATFORM=cpu`` to force the XLA CPU backend (useful off-TPU;
-the container's sitecustomize pins ``jax_platforms`` at the config level,
-so the plain JAX_PLATFORMS env var is not enough).
+The examples run on the XLA CPU backend by default: they cross-check f64
+parity paths, and f64 on TPU is emulated (slow), while the serial-engine
+demos dispatch per date (tunnel round-trips dominate). Set
+``PORQUA_PLATFORM=tpu`` to run on the accelerator (the container's
+sitecustomize pins ``jax_platforms`` at the config level, so the plain
+JAX_PLATFORMS env var alone is not enough — this helper handles it).
 """
 
 from __future__ import annotations
@@ -28,8 +31,8 @@ REFERENCE_DATA = os.environ.get("PORQUA_DATA", "/root/reference/data/")
 def init_platform() -> None:
     import jax
 
-    platform = os.environ.get("PORQUA_PLATFORM")
-    if platform:
+    platform = os.environ.get("PORQUA_PLATFORM", "cpu")
+    if platform != "tpu":
         jax.config.update("jax_platforms", platform)
     # the examples cross-check f64 parity paths; solver code is
     # dtype-parametric and defaults to f32 on device

@@ -22,6 +22,7 @@ identical.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import List, Optional, Sequence
 
 import jax
@@ -219,24 +220,112 @@ def solve_scan_l1(qp: CanonicalQP,
     """
     _require_fixed_universe(universes)
     dtype = qp.P.dtype
-    nvar, m = qp.P.shape[-1], qp.C.shape[-2]
+    nvar = qp.P.shape[-1]
     tc = jnp.asarray(transaction_cost, dtype)
     l1w = jnp.where(jnp.arange(nvar) < n_assets, tc, jnp.asarray(0.0, dtype))
+    w0 = jnp.zeros(nvar, dtype).at[:n_assets].set(
+        jnp.asarray(w_init, dtype)[:n_assets]
+    )
+    return _scan_l1_core(qp, w0, l1w, params)
+
+
+def _scan_l1_core(qp: CanonicalQP, w0, l1w,
+                  params: SolverParams) -> QPSolution:
+    """One column of the chained-L1 backtest: the single scan body
+    shared by :func:`solve_scan_l1` and (vmapped) by
+    :func:`solve_scan_l1_grid`, so the carry/failed-date semantics
+    cannot drift between the two."""
+    dtype = qp.P.dtype
+    nvar, m = qp.P.shape[-1], qp.C.shape[-2]
 
     def step(carry, qp_t):
         w_prev, x_prev, y_prev = carry
         sol = _solve_impl(qp_t, params, x_prev, y_prev,
                           l1_weight=l1w, l1_center=w_prev)
+        # Only advance holdings on a successful solve (the reference
+        # keeps the previous portfolio when a date fails,
+        # backtest.py:212-214).
         ok = sol.status == Status.SOLVED
         w_carry = jnp.where(ok, sol.x, w_prev)
         return (w_carry, sol.x, sol.y), sol
 
-    w0 = jnp.zeros(nvar, dtype).at[:n_assets].set(
-        jnp.asarray(w_init, dtype)[:n_assets]
-    )
     init = (w0, jnp.zeros(nvar, dtype), jnp.zeros(m, dtype))
     _, sols = jax.lax.scan(step, init, qp)
     return sols
+
+
+def solve_scan_l1_grid(qp_grid: CanonicalQP,
+                       n_assets: int,
+                       w_init: jax.Array,
+                       transaction_cost: float,
+                       params: SolverParams = SolverParams(),
+                       mesh=None,
+                       universes: Optional[Sequence[Sequence[str]]] = None
+                       ) -> QPSolution:
+    """Turnover-cost backtests for a whole benchmark/strategy grid:
+    ``lax.scan`` over the coupled dates axis x ``vmap`` over benchmarks,
+    optionally sharded over a device mesh.
+
+    This is SURVEY.md §7's mitigation for the scan-vs-vmap tension:
+    transaction costs chain consecutive dates (inherently sequential),
+    but each benchmark/strategy column is independent, so the scan body
+    solves all B benchmarks' date-t problems concurrently and the B
+    axis rides the mesh over ICI — zero cross-benchmark collectives in
+    the loop (each lane carries its own holdings/warm-start state).
+
+    ``qp_grid`` is a stacked pytree with leading axes ``(B, T)``
+    (benchmarks x dates) over one fixed, identically-ordered asset
+    universe per column (the :func:`solve_scan_l1` precondition;
+    ``universes`` checks it). ``w_init``: (B, n) pre-backtest holdings.
+    ``mesh``: a 1-D :class:`jax.sharding.Mesh`; when given, inputs are
+    placed with the benchmark axis split across its devices and the
+    scan is jitted with matching shardings.
+    """
+    _require_fixed_universe(universes)
+    if qp_grid.P.ndim != 4:
+        raise ValueError(
+            f"qp_grid must have leading (benchmarks, dates) axes — "
+            f"P of shape (B, T, n, n), got {qp_grid.P.shape}; for a "
+            f"single column use solve_scan_l1")
+    dtype = qp_grid.P.dtype
+    B = qp_grid.P.shape[0]
+    nvar = qp_grid.P.shape[-1]
+    tc = jnp.asarray(transaction_cost, dtype)
+    l1w = jnp.where(jnp.arange(nvar) < n_assets, tc, jnp.asarray(0.0, dtype))
+    w0 = jnp.zeros((B, nvar), dtype).at[:, :n_assets].set(
+        jnp.asarray(w_init, dtype)[:, :n_assets])
+
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh_size = int(np.prod(mesh.devices.shape))
+        if B % mesh_size:
+            raise ValueError(
+                f"benchmark axis ({B}) must divide evenly over the mesh "
+                f"({mesh_size} devices); pad the grid with repeated "
+                f"columns (their results are identical and can be "
+                f"dropped)")
+        axis = mesh.axis_names[0]
+
+        def shard(a):
+            spec = (axis,) + (None,) * (a.ndim - 1)
+            return jax.device_put(a, NamedSharding(mesh, P(*spec)))
+
+        qp_grid = jax.tree.map(shard, qp_grid)
+        w0 = shard(w0)
+    return _scan_l1_grid_jit(qp_grid, w0, l1w, params)
+
+
+@functools.partial(jax.jit, static_argnames=("params",))
+def _scan_l1_grid_jit(qp_grid: CanonicalQP, w0, l1w,
+                      params: SolverParams) -> QPSolution:
+    # vmap over the leading benchmark axis of the shared single-column
+    # scan: XLA commutes the vmap into the scan body, yielding the
+    # scan-of-vmapped-solves program with no explicit transposes, and
+    # the module-level jit caches the compilation across calls.
+    return jax.vmap(
+        lambda q, w: _scan_l1_core(q, w, l1w, params)
+    )(qp_grid, w0)
 
 
 def to_strategy(problems: BatchProblems, solution: QPSolution) -> Strategy:

@@ -100,3 +100,61 @@ def test_pad_slots_are_trivial(rng):
     real_iters = np.asarray(sol.iters)[:n_real]
     assert np.all(np.asarray(sol.x)[n_real:] == 0.0)
     assert filler_iters.max() <= real_iters.min()
+
+
+def test_scan_l1_grid_sharded_matches_per_column(rng):
+    """The coupled-dates x benchmarks grid engine: lax.scan over dates,
+    vmap over benchmarks sharded on the mesh, must equal the
+    single-column scan engine run per benchmark (SURVEY §7's
+    scan-over-dates x vmap-over-benchmarks design)."""
+    import jax.numpy as jnp
+
+    from porqua_tpu.batch import solve_scan_l1, solve_scan_l1_grid
+
+    B, T, n = 4, 6, 8
+    tc = 0.002
+    cols = []
+    for b in range(B):
+        dates = []
+        for t in range(T):
+            X = rng.standard_normal((40, n)) * 0.01
+            w_true = rng.dirichlet(np.ones(n))
+            y = X @ w_true
+            dates.append(CanonicalQP.build(
+                2 * X.T @ X, -2 * X.T @ y, C=np.ones((1, n)),
+                l=np.ones(1), u=np.ones(1), lb=np.zeros(n), ub=np.ones(n),
+                dtype=jnp.float64))
+        cols.append(stack_qps(dates))
+    grid = jax.tree.map(lambda *a: jnp.stack(a), *cols)
+
+    params = SolverParams(eps_abs=1e-8, eps_rel=1e-8, max_iter=20000)
+    w_init = np.full((B, n), 1.0 / n)
+
+    mesh = make_mesh(4, axis_names=("bench",))
+    sharded = solve_scan_l1_grid(
+        grid, n, w_init, tc, params=params, mesh=mesh)
+    unsharded = solve_scan_l1_grid(
+        grid, n, w_init, tc, params=params, mesh=None)
+    np.testing.assert_allclose(
+        np.asarray(sharded.x), np.asarray(unsharded.x), atol=1e-10)
+
+    for b in range(B):
+        col = jax.tree.map(lambda a: a[b], grid)
+        ref = solve_scan_l1(col, n, w_init[b], tc, params=params)
+        assert np.all(np.asarray(ref.status) == Status.SOLVED)
+        np.testing.assert_allclose(
+            np.asarray(sharded.x[b]), np.asarray(ref.x), atol=1e-9)
+
+
+def test_scan_l1_grid_rejects_uneven_mesh(rng):
+    import jax.numpy as jnp
+
+    from porqua_tpu.batch import solve_scan_l1_grid
+
+    n = 4
+    qp = CanonicalQP.build(np.eye(n), np.zeros(n), dtype=jnp.float64)
+    grid = jax.tree.map(
+        lambda a: jnp.broadcast_to(a, (3, 2) + a.shape), qp)
+    mesh = make_mesh(8, axis_names=("bench",))
+    with pytest.raises(ValueError, match="divide evenly"):
+        solve_scan_l1_grid(grid, n, np.zeros((3, n)), 0.001, mesh=mesh)
