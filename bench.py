@@ -150,6 +150,15 @@ def make_data_np():
 # Device benchmark (runs inside a subprocess; see device_child)
 # ---------------------------------------------------------------------------
 
+def _bench_polish_k(Xs, ys):
+    """Capacitance dimension the polish actually uses on this workload
+    (None = dense path), straight from the gate in qp/polish.py."""
+    from porqua_tpu.qp.polish import polish_capacitance_dim
+    from porqua_tpu.tracking import build_tracking_qp
+
+    return polish_capacitance_dim(build_tracking_qp(Xs[0], ys[0]))
+
+
 def device_child(platform: str) -> None:
     """Run the device benchmark and print a marker-prefixed JSON line.
 
@@ -183,9 +192,12 @@ def device_child(platform: str) -> None:
     # residual floor is ~1e-3) and let the active-set polish land on
     # the exact solution. Empirically this matches the f64 baseline's
     # tracking error at ~25 iterations/date, while pushing f32 ADMM to
-    # 1e-4 stalls and polishes worse.
+    # 1e-4 stalls and polishes worse. scaling_iters=4: Ruiz converges
+    # on Gram-matrix problems in a few sweeps (verified 25-iter/date
+    # parity vs 10 sweeps on this batch); each extra sweep rereads the
+    # 252 MB P batch.
     params = SolverParams(max_iter=2000, eps_abs=1e-3, eps_rel=1e-3,
-                          polish_passes=1)
+                          polish_passes=1, scaling_iters=4)
 
     t0 = time.perf_counter()
     out = tracking_step_jit(Xs, ys, params)
@@ -196,10 +208,24 @@ def device_child(platform: str) -> None:
     # Measurement discipline (perturbed inputs, device_get completion,
     # first run discarded, median) — shared helper, see its docstring
     # for why block_until_ready alone is not trustworthy here.
-    from porqua_tpu.profiling import measure_device
+    from porqua_tpu.profiling import measure_device, measure_steady_state
 
     dev_s, runs, out = measure_device(
         lambda X: tracking_step_jit(X, ys, params), Xs)
+
+    # The tunnel between this host and the TPU adds ~70 ms of dispatch
+    # + completion latency to EVERY call — a property of this
+    # container's transport, not of the program (a local PCIe host
+    # pays ~none of it). Report the steady-state device time too:
+    # k repetitions of the full step over perturbed inputs inside ONE
+    # dispatch, per-step = (t_k - t_1) / (k - 1), which cancels the
+    # per-dispatch constant exactly. "value" below stays the
+    # single-dispatch number (conservative; includes the tunnel).
+    steady_s = measure_steady_state(
+        lambda X: jnp.sum(tracking_step_jit(X, ys, params).tracking_error),
+        Xs)
+    log(f"steady-state device time: {steady_s*1e3:.1f} ms/step "
+        f"(single-dispatch {dev_s*1e3:.1f} ms incl. tunnel RTT)")
     solved = int(np.sum(np.asarray(out.status) == 1))
     te_dev = float(np.median(np.asarray(out.tracking_error)))
     iters_med = float(np.median(np.asarray(out.iters)))
@@ -219,8 +245,15 @@ def device_child(platform: str) -> None:
         # linsolve="auto" resolves per backend: trinv on TPU, chol on
         # the CPU fallback — the model must count what actually ran.
         linsolve="trinv" if dev.platform == "tpu" else "chol",
+        # The tracking QP carries its factor (P = 2 X'X), so the polish
+        # runs the exact-pinning capacitance path when it pays; ask the
+        # gate itself so the model counts exactly what ran.
+        polish_k=_bench_polish_k(Xs, ys),
     )
-    roofline = roofline_report(model, dev_s, str(dev.device_kind))
+    # Roofline against the steady-state seconds: the tunnel's ~70 ms
+    # per-dispatch latency is transport, not device time.
+    roofline = roofline_report(
+        model, steady_s if steady_s > 0 else dev_s, str(dev.device_kind))
     log("roofline: " + ", ".join(
         f"{k}={v:.3g}" if isinstance(v, float) else f"{k}={v}"
         for k, v in roofline.items()
@@ -231,6 +264,7 @@ def device_child(platform: str) -> None:
         "platform": dev.platform,
         "device_kind": str(dev.device_kind),
         "seconds": dev_s,
+        "seconds_steady_state": steady_s,
         "runs": runs,
         "compile_s": compile_s,
         "solved": solved,
@@ -342,6 +376,15 @@ def main():
         payload["vs_baseline"] = (
             round(base_s / result["seconds"], 2) if base_s is not None
             else 0.0)
+        steady = result.get("seconds_steady_state") or 0.0
+        if steady > 0:
+            # Device time with the container's ~70 ms/dispatch TPU
+            # tunnel latency cancelled (k steps in one dispatch); the
+            # headline "value" keeps the conservative single-dispatch
+            # number — see device_child.
+            payload["seconds_steady_state"] = round(steady, 4)
+            if base_s is not None:
+                payload["vs_baseline_steady_state"] = round(base_s / steady, 2)
         payload.update({
             "device": result["platform"],
             "device_kind": result["device_kind"],

@@ -78,8 +78,18 @@ def _trivial_problem_like(qp: CanonicalQP) -> CanonicalQP:
     n, m = qp.n, qp.m
     dt = qp.P.dtype
     zeros_n = jnp.zeros((1, n), dt)
+    # The filler must preserve the batch's P == 2 Pf'Pf + diag(Pdiag)
+    # invariant (solver paths may read either form). With Pf present the
+    # filler factor is 0, so the dense P must match: diag(Pdiag) when a
+    # diagonal completion exists (identity), else exactly zero — the
+    # lb = ub = 0 box pins the solution regardless of the objective.
+    Pdiag_fill = None if qp.Pdiag is None else jnp.ones((1, n), dt)
+    if qp.Pf is not None and qp.Pdiag is None:
+        P_fill = jnp.zeros((1, n, n), dt)
+    else:
+        P_fill = jnp.eye(n, dtype=dt)[None]
     return CanonicalQP(
-        P=jnp.eye(n, dtype=dt)[None],
+        P=P_fill,
         q=zeros_n,
         C=jnp.zeros((1, m, n), dt),
         l=jnp.zeros((1, m), dt),
@@ -89,6 +99,8 @@ def _trivial_problem_like(qp: CanonicalQP) -> CanonicalQP:
         var_mask=jnp.ones((1, n), dt),
         row_mask=jnp.zeros((1, m), dt),
         constant=jnp.zeros((1,), dt),
+        Pf=None if qp.Pf is None else jnp.zeros((1,) + qp.Pf.shape[-2:], dt),
+        Pdiag=Pdiag_fill,
     )
 
 

@@ -92,8 +92,21 @@ class SolverParams:
     # "inverse" — explicit KKT inverse with one Newton refinement;
     #             cheapest per iteration but the f32 error budget costs
     #             extra segments on ill-conditioned problems.
+    # "woodbury"— explicit opt-in; requires ``qp.Pf`` (P = 2 Pf'Pf +
+    #             diag(Pdiag)) and raises ValueError without it: the
+    #             segment factorizations run on the r x r capacitance
+    #             matrix S = I + V D^-1 V' instead of the n x n KKT.
+    #             Measured NOT to pay on the north-star batch (see
+    #             resolve_linsolve) — the factored structure is instead
+    #             exploited by the polish, unconditionally, whenever
+    #             qp.Pf is present (qp.polish._kkt_solve_factored).
     # "auto"    — "trinv" on TPU, "chol" elsewhere.
     linsolve: str = "auto"
+    # Inner iterative-refinement steps of the Woodbury apply (residual
+    # via the factor form, two extra matvec pairs each). 1 restores
+    # trinv-grade ADMM convergence on the north-star batch; the raw
+    # apply (0) stalls the worst-conditioned lanes just above eps.
+    woodbury_refine: int = 1
     # VMEM budget for the fused Pallas segment (Kinv + C + state vectors
     # must all be core-resident; ~16 MB/core on v5e, leave headroom).
     # backend="auto" falls back to the XLA path above this footprint.
@@ -254,6 +267,89 @@ def _infeasibility(qp: CanonicalQP, scaling: Scaling, dx, dy, dmu,
     return prim_infeas.astype(jnp.bool_), dual_infeas.astype(jnp.bool_), jnp.asarray(0, dtype)
 
 
+def resolve_linsolve(params: SolverParams, qp: CanonicalQP) -> str:
+    """Resolve ``params.linsolve`` against this problem's structure.
+
+    Governs the ADMM segment's linear solve only. The polish chooses
+    its factored path independently (on ``qp.Pf`` presence + dimension,
+    ``qp/polish.py``) — the exact-pinning KKT solve is a win there
+    regardless of which operator the segments used.
+    """
+    ls = params.linsolve
+    if ls == "woodbury":
+        # Explicit opt-in only. Measured on the north-star batch the
+        # capacitance-sized factorizations do NOT pay inside the ADMM
+        # loop: the apply's refinement triples per-iteration cost and
+        # the worst-conditioned lanes still need extra segments, which
+        # the batched while_loop charges to every lane (3.7 s vs 95 ms
+        # for trinv). The factored structure pays in the *polish*
+        # (exact pinning, no penalty amplification), which uses it
+        # automatically whenever qp.Pf is present — see qp.polish.
+        if qp.Pf is None:
+            raise ValueError(
+                "linsolve='woodbury' requires the factored objective "
+                "(qp.Pf with P = 2 Pf'Pf + diag(Pdiag))")
+        if params.backend == "pallas":
+            raise ValueError(
+                "linsolve='woodbury' is not available inside the fused "
+                "Pallas segment; use backend='xla'")
+        return "woodbury"
+    if ls == "auto":
+        return "trinv" if jax.default_backend() == "tpu" else "chol"
+    return ls
+
+
+def factored_spd_solve_operator(Dv: jax.Array, V: jax.Array,
+                                refine_steps: int = 1):
+    """Solve operator for the SPD matrix ``K = diag(Dv) + V' V``.
+
+    Woodbury identity with the capacitance matrix
+    ``S = I + (V D^-1) V'`` (k x k, k = V rows):
+
+        K^-1 r = D^-1 r - W' (W r),   W = L_S^-1 (V D^-1),  S = L_S L_S'
+
+    Every factorization-class op (Cholesky + triangular inverse) runs at
+    k x k instead of n x n — for a least-squares objective over a
+    T-observation window with m constraint rows, k = T + m, i.e.
+    ~((T+m)/n)^3 of the dense-KKT FLOPs, and each application is two
+    (k x n) MXU matvecs reading half the bytes of an n x n factor.
+
+    The raw Woodbury apply cancels ``D^-1 r`` against the correction
+    term, so its relative error scales with cond(K) * eps — enough to
+    stall f32 ADMM (measured 100 vs 25 segments-north-star). Each
+    ``refine_steps`` round of iterative refinement (residual via the
+    factor form ``K x = D x + V'(V x)``, two extra matvec pairs)
+    multiplies the error by that same factor, restoring trinv-grade
+    accuracy for ~2x the (cheap) per-application cost.
+    """
+    from jax.scipy.linalg import solve_triangular
+
+    dtype = V.dtype
+    k = V.shape[-2]
+    hp = jax.lax.Precision.HIGHEST
+    inv_d = 1.0 / Dv
+    Vd = V * inv_d[None, :]
+    S = jnp.eye(k, dtype=dtype) + jnp.dot(Vd, V.T, precision=hp)
+    L = jnp.linalg.cholesky(S)
+    Linv = solve_triangular(L, jnp.eye(k, dtype=dtype), lower=True)
+    W = jnp.dot(Linv, Vd, precision=hp)
+
+    def base(rhs):
+        t = jnp.dot(W, rhs, precision=hp)
+        return rhs * inv_d - jnp.dot(t, W, precision=hp)
+
+    def apply_K(x):
+        return Dv * x + jnp.dot(jnp.dot(V, x, precision=hp), V, precision=hp)
+
+    def solve(rhs):
+        x = base(rhs)
+        for _ in range(refine_steps):
+            x = x + base(rhs - apply_K(x))
+        return x
+
+    return solve
+
+
 def admm_solve(qp: CanonicalQP,
                scaling: Scaling,
                params: SolverParams,
@@ -351,10 +447,8 @@ def admm_solve(qp: CanonicalQP,
                 "path); use backend='auto' unless this is a parity test.",
                 stacklevel=2,
             )
-    linsolve = params.linsolve
-    if linsolve == "auto":
-        linsolve = "trinv" if jax.default_backend() == "tpu" else "chol"
-    use_inverse = use_pallas or linsolve in ("inverse", "trinv")
+    linsolve = resolve_linsolve(params, qp)
+    use_inverse = use_pallas or linsolve in ("inverse", "trinv", "woodbury")
 
     # Every explicit-inverse linear solve — the Pallas kernel,
     # linsolve="inverse", and linsolve="trinv" (the TPU default) —
@@ -415,12 +509,38 @@ def admm_solve(qp: CanonicalQP,
 
     def segment(state: ADMMState) -> ADMMState:
         rho, rho_b = _rho_vectors(qp, state.rho_bar, params)
-        K = (
-            qp.P
-            + sigma * jnp.eye(n, dtype=dtype)
-            + (qp.C.T * rho) @ qp.C
-            + jnp.diag(rho_b)
-        )
+        if linsolve == "woodbury":
+            # K = diag(sigma + Pdiag + rho_b) + 2 Pf'Pf + C' diag(rho) C.
+            # The factor block goes through the capacitance matrix; the
+            # m constraint rows are eliminated by their own (tiny) Schur
+            # complement G = diag(1/rho) + C K0^-1 C' instead of being
+            # stacked into V — their rho carries the rho_eq_scale
+            # up-weighting (1e3x on equality rows), which would square
+            # the capacitance conditioning and stall the worst lanes
+            # (measured: 26/252 north-star dates at max_iter when
+            # stacked). The dense n x n K is never materialized.
+            pd = 0.0 if qp.Pdiag is None else qp.Pdiag
+            Dv = sigma + pd + rho_b
+            V = jnp.sqrt(jnp.asarray(2.0, dtype)) * qp.Pf
+            psolve0 = factored_spd_solve_operator(
+                Dv, V, refine_steps=params.woodbury_refine)
+            hp = jax.lax.Precision.HIGHEST
+            Y0 = jax.vmap(psolve0, in_axes=1, out_axes=1)(qp.C.T)  # (n, m)
+            G = jnp.diag(1.0 / rho) + jnp.dot(qp.C, Y0, precision=hp)
+
+            def solve(rhs):
+                x0 = psolve0(rhs)
+                t = jnp.linalg.solve(G, jnp.dot(qp.C, x0, precision=hp))
+                return x0 - jnp.dot(Y0, t, precision=hp)
+
+            K = None
+        else:
+            K = (
+                qp.P
+                + sigma * jnp.eye(n, dtype=dtype)
+                + (qp.C.T * rho) @ qp.C
+                + jnp.diag(rho_b)
+            )
 
         if use_pallas:
             # Fused segment with the linear-solve operator VMEM-resident
@@ -448,7 +568,9 @@ def admm_solve(qp: CanonicalQP,
             )
         else:
             hp = jax.lax.Precision.HIGHEST
-            if linsolve == "trinv":
+            if linsolve == "woodbury":
+                pass  # `solve` built above with the eq-row Schur split
+            elif linsolve == "trinv":
                 Linv = triangular_inverse(K)
                 solve = lambda rhs: jnp.dot(
                     jnp.dot(Linv, rhs, precision=hp), Linv, precision=hp)

@@ -57,6 +57,16 @@ class CanonicalQP(NamedTuple):
     var_mask: jax.Array   # (n,)   1.0 for real variables, 0.0 for padding
     row_mask: jax.Array   # (m,)   1.0 for real rows, 0.0 for padding
     constant: jax.Array   # ()     objective constant
+    # Optional low-rank structure: P == 2 Pf' Pf + diag(Pdiag) exactly.
+    # Least-squares objectives (index tracking, P = 2 X'X — reference
+    # ``optimization.py:206-226``) and sample-covariance objectives have
+    # r = window << n on large universes; when present, the solver's
+    # linear solves run in the r x r dual space (Woodbury) instead of
+    # n x n — ~ (r/n)^3 of the factorization FLOPs (see qp.admm,
+    # linsolve="woodbury"). ``None`` means "no known structure": every
+    # consumer must fall back to the dense ``P``.
+    Pf: Optional[jax.Array] = None     # (r, n) objective factor
+    Pdiag: Optional[jax.Array] = None  # (n,)   diagonal completion
 
     @property
     def n(self) -> int:

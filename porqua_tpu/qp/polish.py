@@ -27,9 +27,90 @@ import jax
 import jax.numpy as jnp
 from jax.scipy.linalg import cho_factor, cho_solve
 
-from porqua_tpu.qp.admm import SolverParams, _residuals
+from porqua_tpu.qp.admm import (
+    SolverParams,
+    _residuals,
+    factored_spd_solve_operator,
+)
 from porqua_tpu.qp.canonical import CanonicalQP
 from porqua_tpu.qp.ruiz import Scaling
+
+
+def polish_capacitance_dim(qp: CanonicalQP):
+    """Capacitance dimension (r + m) the factored polish will use for
+    this problem, or ``None`` when the dense penalty polish runs — the
+    single source of truth for the gate (bench.py's roofline model and
+    :func:`polish` both consult it so they cannot drift)."""
+    if qp.Pf is None:
+        return None
+    k = qp.Pf.shape[-2] + qp.m
+    return k if k < qp.n else None
+
+
+def _kkt_solve_factored(qp: CanonicalQP, params: SolverParams,
+                        aB, aC, bound_B, bound_C, q_eff):
+    """Active-set KKT solve in the factored (Woodbury) regime.
+
+    The penalty form the dense path uses (``M = P + dI + (1/d) actives``)
+    is hostile to the Woodbury apply: the (1/d) up-weighting squares the
+    capacitance conditioning and the refinement rhs multiplies residual
+    roundoff by 1/d. Here actives are instead pinned *exactly*:
+
+        x = aB * bound_B + Z x_f,   Z = 1 - aB,
+        (Z P Z + diag(aB) + sigma I) x_f = Z (-q_eff - P x_a - C'aC nu),
+        aC C x = aC bound_C           (Schur complement on the m duals)
+
+    The projected Hessian keeps the factor form (Z P Z = 2 (Pf Z)'(Pf Z)
+    + diag(Pdiag Z)), so every solve is a (r+m)-dim capacitance solve;
+    pinned coordinates are reproduced exactly (their V columns vanish),
+    the m x m Schur system handles the general rows exactly, and the
+    refinement loop below iterates the TRUE KKT residuals with no 1/d
+    amplification anywhere.
+    """
+    dtype = qp.P.dtype
+    n, m = qp.n, qp.m
+    sigma = jnp.maximum(
+        jnp.asarray(params.polish_delta, dtype),
+        jnp.sqrt(jnp.asarray(jnp.finfo(dtype).eps, dtype)))
+    hp = jax.lax.Precision.HIGHEST
+
+    pd = jnp.zeros(n, dtype) if qp.Pdiag is None else qp.Pdiag
+    Z = 1.0 - aB
+    x_a = aB * bound_B
+
+    def apply_P(v):
+        Fv = jnp.dot(qp.Pf, v, precision=hp)
+        return 2.0 * jnp.dot(Fv, qp.Pf, precision=hp) + pd * v
+
+    Dt = aB + sigma + pd * Z
+    V = jnp.sqrt(jnp.asarray(2.0, dtype)) * qp.Pf * Z[None, :]
+    psolve = factored_spd_solve_operator(Dt, V, refine_steps=1)
+
+    CaT = (qp.C * aC[:, None]).T                      # (n, m) masked rows
+    Y = jax.vmap(psolve, in_axes=1, out_axes=1)(Z[:, None] * CaT)
+    G = aC[:, None] * jnp.dot(qp.C, Y, precision=hp) \
+        + jnp.diag(1.0 - aC)                           # (m, m)
+
+    def schur_step(rhs_z, r2):
+        """Solve the projected KKT for (dx, dnu) given Z-space rhs and
+        the active-row residual r2 = aC (bound - C x)."""
+        b0 = psolve(rhs_z)
+        g = aC * jnp.dot(qp.C, b0, precision=hp) - r2
+        dnu = jnp.linalg.solve(G, g)
+        dx = b0 - jnp.dot(Y, dnu, precision=hp)
+        return dx, dnu
+
+    x, nu = x_a, jnp.zeros(m, dtype)
+    for _ in range(1 + params.polish_refine_steps):
+        s = apply_P(x) + q_eff + jnp.dot(aC * nu, qp.C, precision=hp)
+        r2 = aC * (bound_C - jnp.dot(qp.C, x, precision=hp))
+        dx, dnu = schur_step(-Z * s, r2)
+        x = x + dx
+        nu = nu + dnu
+
+    tau = -aB * (apply_P(x) + q_eff
+                 + jnp.dot(aC * nu, qp.C, precision=hp))
+    return x, aC * nu, tau
 
 
 def polish(qp: CanonicalQP,
@@ -121,6 +202,12 @@ def polish(qp: CanonicalQP,
     bound_B = jnp.where(act_up_B & ~act_low_B, qp.ub, qp.lb)
     bound_B = jnp.where(jnp.isfinite(bound_B), bound_B, 0.0)
 
+    # The exact-pinning factored KKT solve is used whenever the
+    # objective factor pays, independent of the ADMM segment's linsolve
+    # choice — it is both cheaper (capacitance-sized factorizations)
+    # and at least as accurate (no 1/delta penalty amplification) than
+    # the dense penalty form; parity is pinned by test_woodbury.py.
+    use_woodbury = polish_capacitance_dim(qp) is not None
     eye_n = jnp.eye(n, dtype=dtype)
     # In f32 the (1/delta)-weighted Schur complement must stay within
     # what a Cholesky + refinement can represent; sqrt(machine eps) is
@@ -153,19 +240,23 @@ def polish(qp: CanonicalQP,
         bC = aC_i * bound_C
         bB = aB_i * bound_B_i
 
+        if use_woodbury:
+            return _kkt_solve_factored(
+                qp, params, aB_i, aC_i, bound_B_i, bound_C, q_eff_i)
         M = (
             qp.P + delta * eye_n
             + inv_d * ((qp.C.T * aC_i) @ qp.C + jnp.diag(aB_i))
         )
         cholM = cho_factor(M)
-        x_i = cho_solve(cholM, -q_eff_i + inv_d * (qp.C.T @ bC + bB))
+        msolve = lambda v: cho_solve(cholM, v)
+        x_i = msolve(-q_eff_i + inv_d * (qp.C.T @ bC + bB))
         nu = aC_i * (qp.C @ x_i - bound_C) * inv_d
         tau = aB_i * (x_i - bound_B_i) * inv_d
         for _ in range(params.polish_refine_steps):
             r1 = -q_eff_i - (qp.P @ x_i + qp.C.T @ nu + tau)
             r2 = aC_i * (bound_C - qp.C @ x_i)
             r3 = aB_i * (bound_B_i - x_i)
-            dx = cho_solve(cholM, r1 + inv_d * (qp.C.T @ r2 + r3))
+            dx = msolve(r1 + inv_d * (qp.C.T @ r2 + r3))
             nu = nu + aC_i * (qp.C @ dx - r2) * inv_d
             tau = tau + aB_i * (dx - r3) * inv_d
             x_i = x_i + dx

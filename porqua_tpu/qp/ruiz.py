@@ -91,4 +91,12 @@ def equilibrate(qp: CanonicalQP, iters: int = 10) -> Tuple[CanonicalQP, Scaling]
         ub=qp.ub / D,
         constant=qp.constant * c,
     )
+    if qp.Pf is not None:
+        # P = 2 Pf'Pf + diag(Pdiag) -> c D P D = 2 (sqrt(c) Pf D)' (...)
+        # + diag(c D^2 Pdiag): the factor form survives diagonal scaling,
+        # so the Woodbury solve path stays available on the scaled
+        # problem.
+        scaled = scaled._replace(Pf=jnp.sqrt(c) * qp.Pf * D[None, :])
+        if qp.Pdiag is not None:
+            scaled = scaled._replace(Pdiag=c * D * D * qp.Pdiag)
     return scaled, Scaling(D=D, E=E, c=c)

@@ -1,0 +1,99 @@
+"""Amortized stage timing: run each stage k times inside ONE dispatch.
+
+The axon tunnel adds ~70 ms of latency to every dispatch+device_get
+round trip, swamping sub-100 ms kernels when timed one call at a time
+(see profile_stages.py). Here each stage runs ``k`` times inside a
+single jitted lax.scan over perturbed inputs; stage cost =
+(t(k) - t(1)) / (k - 1), which cancels the dispatch floor exactly.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+import functools
+
+from porqua_tpu.profiling import measure_steady_state
+from porqua_tpu.tracking import synthetic_universe_np
+
+B = int(os.environ.get("PROF_B", 252))
+T = int(os.environ.get("PROF_T", 252))
+N = int(os.environ.get("PROF_N", 500))
+K_REP = int(os.environ.get("PROF_K", 8))
+
+amortized = functools.partial(measure_steady_state, k=K_REP, return_floor=True)
+
+
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} {dev.device_kind}  B={B} T={T} N={N} "
+          f"k={K_REP}", flush=True)
+    Xs_np, ys_np = synthetic_universe_np(seed=42, n_dates=B, window=T,
+                                         n_assets=N)
+    Xs = jnp.asarray(Xs_np)
+    ys = jnp.asarray(ys_np)
+    import jax.scipy.linalg as jsl
+
+    P = jax.jit(lambda X: 2.0 * jnp.einsum("bti,btj->bij", X, X))(Xs)
+    K = P + 0.1 * jnp.eye(N)[None]
+    L = jax.jit(jnp.linalg.cholesky)(K)
+    Linv = jax.jit(lambda L: jax.vmap(
+        lambda Li: jsl.solve_triangular(Li, jnp.eye(N, dtype=Li.dtype),
+                                        lower=True))(L))(L)
+    Ki = jax.jit(lambda Li: jnp.einsum("bki,bkj->bij", Li, Li))(Linv)
+    jax.block_until_ready((K, L, Linv, Ki))
+
+    stages = [
+        ("gram", lambda X: jnp.sum(jnp.einsum("bti,btj->bij", X, X)), Xs),
+        ("cholesky", lambda K: jnp.sum(jnp.linalg.cholesky(K)), K),
+        ("trinv(trsm nrhs)", lambda L: jnp.sum(jax.vmap(
+            lambda Li: jsl.solve_triangular(
+                Li, jnp.eye(N, dtype=Li.dtype), lower=True))(L)), L),
+        ("Linv->Kinv", lambda Li: jnp.sum(
+            jnp.einsum("bki,bkj->bij", Li, Li)), Linv),
+        ("25 matvec bmm", lambda Ki: jnp.sum(jax.lax.fori_loop(
+            0, 25, lambda i, x: 0.99 * (Ki @ x) + 1e-3,
+            Ki[:, :, :1])), Ki),
+        ("25 it 2xtri", lambda Li: jnp.sum(jax.lax.fori_loop(
+            0, 25, lambda i, x: 0.99 * jnp.einsum(
+                "bki,bi->bk", Li, jnp.einsum("bki,bk->bi", Li, x)) + 1e-3,
+            Li[:, 0])), Linv),
+        ("full-chol solve x5", _polish_stage, K),
+    ]
+    for name, fn, arg in stages:
+        per, floor = amortized(fn, arg)
+        print(f"{name:20s} {per*1e3:8.2f} ms  (dispatch floor {floor*1e3:6.1f} ms)",
+              flush=True)
+
+    # full tracking step, amortized the same way
+    from porqua_tpu.qp.solve import SolverParams
+    from porqua_tpu.tracking import tracking_step
+    params = SolverParams(max_iter=2000, eps_abs=1e-3, eps_rel=1e-3,
+                          polish_passes=1)
+    per, floor = amortized(
+        lambda X: jnp.sum(tracking_step(X, ys, params).tracking_error), Xs,
+        k=4)
+    print(f"{'full tracking_step':20s} {per*1e3:8.2f} ms  "
+          f"(dispatch floor {floor*1e3:6.1f} ms)", flush=True)
+
+
+def _polish_stage(K):
+    import jax.scipy.linalg as jsl
+    L2 = jnp.linalg.cholesky(K)
+    qq = K[:, :, 0:1]
+    h = jsl.solve_triangular(L2, qq, lower=True)
+    x = jsl.solve_triangular(jnp.swapaxes(L2, -1, -2), h, lower=False)
+    for _ in range(3):
+        r = qq - K @ x
+        h = jsl.solve_triangular(L2, r, lower=True)
+        x = x + jsl.solve_triangular(jnp.swapaxes(L2, -1, -2), h, lower=False)
+    return jnp.sum(x)
+
+
+if __name__ == "__main__":
+    main()

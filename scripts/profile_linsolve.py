@@ -1,0 +1,51 @@
+"""Amortized TPU wall-clock of the full north-star step per linsolve mode."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import functools
+
+from porqua_tpu.profiling import measure_steady_state
+from porqua_tpu.qp.solve import SolverParams
+from porqua_tpu.tracking import synthetic_universe_np, tracking_step
+
+B, T, N = 252, 252, 500
+
+amortized = functools.partial(measure_steady_state, k=4, return_floor=True)
+
+
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} {dev.device_kind}", flush=True)
+    Xs_np, ys_np = synthetic_universe_np(seed=42, n_dates=B, window=T,
+                                         n_assets=N)
+    Xs, ys = jnp.asarray(Xs_np), jnp.asarray(ys_np)
+
+    for ls in ("trinv", "woodbury"):
+        params = SolverParams(max_iter=2000, eps_abs=1e-3, eps_rel=1e-3,
+                              polish_passes=1, linsolve=ls)
+
+        def stage(X):
+            out = tracking_step(X, ys, params)
+            return (jnp.sum(out.tracking_error)
+                    + jnp.sum(out.iters).astype(jnp.float32) * 0.0)
+
+        per, floor = amortized(stage, Xs)
+        out = jax.jit(lambda X: tracking_step(X, ys, params))(Xs)
+        te = float(jnp.median(out.tracking_error))
+        solved = int(jnp.sum(out.status == 1))
+        iters = float(jnp.median(out.iters))
+        print(f"{ls:9s}: {per*1e3:7.2f} ms/step amortized "
+              f"(dispatch floor {floor*1e3:6.1f} ms), solved {solved}/{B}, "
+              f"median TE {te:.4e}, median iters {iters:.0f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,77 @@
+"""Amortized TPU cost of the non-factorization stages: ruiz, K assembly,
+residual checks, unscale/objective — the ~20 ms of 'misc' between the
+accounted stages and the measured whole."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+import functools
+
+from porqua_tpu.profiling import measure_steady_state
+from porqua_tpu.qp.admm import SolverParams, _residuals, _rho_vectors
+from porqua_tpu.qp.ruiz import equilibrate
+from porqua_tpu.tracking import build_tracking_qp, synthetic_universe_np
+
+B, T, N = 252, 252, 500
+
+amortized = functools.partial(measure_steady_state, k=6, return_floor=True)
+
+
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} {dev.device_kind}", flush=True)
+    Xs_np, ys_np = synthetic_universe_np(seed=42, n_dates=B, window=T,
+                                         n_assets=N)
+    Xs, ys = jnp.asarray(Xs_np), jnp.asarray(ys_np)
+    params = SolverParams(max_iter=2000, eps_abs=1e-3, eps_rel=1e-3,
+                          polish_passes=1)
+
+    build = jax.jit(jax.vmap(build_tracking_qp))
+    qp = build(Xs, ys)
+    jax.block_until_ready(qp.P)
+
+    per, _ = amortized(lambda X: jnp.sum(
+        jax.vmap(build_tracking_qp)(X, ys).P), Xs)
+    print(f"build qp (gram):     {per*1e3:8.2f} ms", flush=True)
+
+    for it in (10, 4, 2):
+        per, _ = amortized(lambda q, it=it: jnp.sum(
+            jax.vmap(lambda one: equilibrate(one, iters=it)[0].P)(q)), qp)
+        print(f"ruiz x{it}:            {per*1e3:8.2f} ms", flush=True)
+
+    scaled = jax.jit(jax.vmap(lambda one: equilibrate(one, iters=10)))(qp)
+    sq, sc = scaled
+    jax.block_until_ready(sq.P)
+
+    def k_assemble(q):
+        def one(qq):
+            rho, rho_b = _rho_vectors(qq, jnp.asarray(0.1, qq.P.dtype), params)
+            K = (qq.P + params.sigma * jnp.eye(N, dtype=qq.P.dtype)
+                 + (qq.C.T * rho) @ qq.C + jnp.diag(rho_b))
+            return jnp.sum(K)
+        return jnp.sum(jax.vmap(one)(q))
+    per, _ = amortized(k_assemble, sq)
+    print(f"K assembly:          {per*1e3:8.2f} ms", flush=True)
+
+    x = jnp.ones((B, N), sq.P.dtype) / N
+
+    def resid(q):
+        def one(qq, xx):
+            z = qq.C @ xx
+            r = _residuals(qq, jax.tree.map(lambda a: a[0], sc), xx, z,
+                           xx, jnp.zeros(1, qq.P.dtype),
+                           jnp.zeros(N, qq.P.dtype), params)
+            return r[0] + r[1]
+        return jnp.sum(jax.vmap(one, in_axes=(0, 0))(q, x))
+    per, _ = amortized(resid, sq)
+    print(f"residual check:      {per*1e3:8.2f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()

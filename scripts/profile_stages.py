@@ -1,0 +1,136 @@
+"""Stage-level timing of the north-star program on the live device.
+
+Times each ingredient of tracking_step separately (batched over the
+full 252-date batch): Gram assembly, Cholesky, triangular inverse,
+N ADMM-style matvec iterations, polish-shaped solve — to locate where
+the 0.19 s goes relative to the ~20 ms roofline minimum.
+
+Measurement notes (hard-won):
+* every stage is wrapped to return a SCALAR (jnp.sum of the result) —
+  the axon tunnel moves device->host bytes at single-digit MB/s, so
+  fetching a 252 MB intermediate swamps the kernel time by 1000x;
+* inputs are perturbed per run and one output leaf is device_get
+  (measure_device discipline, see porqua_tpu.profiling).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from porqua_tpu.profiling import measure_device
+from porqua_tpu.tracking import synthetic_universe_np
+
+B = int(os.environ.get("PROF_B", 252))
+T = int(os.environ.get("PROF_T", 252))
+N = int(os.environ.get("PROF_N", 500))
+
+
+def timeit(fn, arg, n=4):
+    med, _, _ = measure_device(fn, arg, n_runs=n)
+    return med
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} {dev.device_kind}  B={B} T={T} N={N}",
+          flush=True)
+    Xs_np, ys_np = synthetic_universe_np(seed=42, n_dates=B, window=T, n_assets=N)
+    Xs = jnp.asarray(Xs_np)
+    ys = jnp.asarray(ys_np)
+
+    import jax.scipy.linalg as jsl
+
+    @jax.jit
+    def gram(Xs):
+        P = 2.0 * jnp.einsum("bti,btj->bij", Xs, Xs)
+        return jnp.sum(P)
+
+    @jax.jit
+    def gram_full(Xs):
+        return 2.0 * jnp.einsum("bti,btj->bij", Xs, Xs)
+
+    P = gram_full(Xs)
+    K = P + 0.1 * jnp.eye(N)[None]
+    jax.block_until_ready(K)
+    print(f"gram:                {timeit(gram, Xs)*1e3:8.2f} ms", flush=True)
+
+    chol = jax.jit(lambda K: jnp.sum(jnp.linalg.cholesky(K)))
+    L = jax.jit(lambda K: jnp.linalg.cholesky(K))(K)
+    jax.block_until_ready(L)
+    print(f"cholesky:            {timeit(chol, K)*1e3:8.2f} ms", flush=True)
+
+    trinv = jax.jit(lambda L: jnp.sum(jax.vmap(
+        lambda Li: jsl.solve_triangular(Li, jnp.eye(N, dtype=Li.dtype),
+                                        lower=True))(L)))
+    Linv = jax.jit(lambda L: jax.vmap(
+        lambda Li: jsl.solve_triangular(Li, jnp.eye(N, dtype=Li.dtype),
+                                        lower=True))(L))(K * 0 + L)
+    jax.block_until_ready(Linv)
+    print(f"trinv (n-rhs trsm):  {timeit(trinv, L)*1e3:8.2f} ms", flush=True)
+
+    kinv = jax.jit(lambda Linv: jnp.sum(jnp.einsum("bki,bkj->bij", Linv, Linv)))
+    print(f"Linv->Kinv einsum:   {timeit(kinv, Linv)*1e3:8.2f} ms", flush=True)
+
+    Ki = jax.jit(lambda Linv: jnp.einsum("bki,bkj->bij", Linv, Linv))(Linv)
+    q = jax.jit(lambda Xs, ys: -2.0 * jnp.einsum("bti,bt->bi", Xs, ys))(Xs, ys)
+    jax.block_until_ready((Ki, q))
+
+    @jax.jit
+    def it25(Ki):
+        def body(i, x):
+            return 0.99 * jnp.einsum("bij,bj->bi", Ki, x) + 1e-3
+        return jnp.sum(jax.lax.fori_loop(0, 25, body, Ki[:, 0]))
+    print(f"25 matvec (einsum):  {timeit(it25, Ki)*1e3:8.2f} ms", flush=True)
+
+    @jax.jit
+    def it25mm(Ki):
+        def body(i, x):
+            return 0.99 * (Ki @ x) + 1e-3
+        return jnp.sum(jax.lax.fori_loop(0, 25, body, Ki[:, :, :1]))
+    print(f"25 matvec (bmm):     {timeit(it25mm, Ki)*1e3:8.2f} ms", flush=True)
+
+    @jax.jit
+    def it25tri(Linv):
+        def body(i, x):
+            h = jnp.einsum("bki,bk->bi", Linv, x)
+            return 0.99 * jnp.einsum("bki,bi->bk", Linv, h) + 1e-3
+        return jnp.sum(jax.lax.fori_loop(0, 25, body, Linv[:, 0]))
+    print(f"25 it 2xtri matvec:  {timeit(it25tri, Linv)*1e3:8.2f} ms", flush=True)
+
+    # wider batch per matvec: 8 RHS columns per problem (simulates an
+    # 8-problem-block kernel's MXU utilization)
+    @jax.jit
+    def it25w8(Ki):
+        def body(i, x):
+            return 0.99 * (Ki @ x) + 1e-3
+        return jnp.sum(jax.lax.fori_loop(0, 25, body, Ki[:, :, :8]))
+    print(f"25 matvec (8 rhs):   {timeit(it25w8, Ki)*1e3:8.2f} ms", flush=True)
+
+    @jax.jit
+    def polish_shape(K):
+        L2 = jnp.linalg.cholesky(K)
+        qq = K[:, :, 0:1]
+        h = jsl.solve_triangular(L2, qq, lower=True)
+        x = jsl.solve_triangular(jnp.swapaxes(L2, -1, -2), h, lower=False)
+        for _ in range(3):
+            r = qq - K @ x
+            h = jsl.solve_triangular(L2, r, lower=True)
+            x = x + jsl.solve_triangular(jnp.swapaxes(L2, -1, -2), h, lower=False)
+        return jnp.sum(x)
+    print(f"polish chol+4solves: {timeit(polish_shape, K)*1e3:8.2f} ms", flush=True)
+
+    from porqua_tpu.qp.solve import SolverParams
+    from porqua_tpu.tracking import tracking_step_jit
+    params = SolverParams(max_iter=2000, eps_abs=1e-3, eps_rel=1e-3,
+                          polish_passes=1)
+    out = tracking_step_jit(Xs, ys, params)
+    jax.block_until_ready(out.weights)
+    full = timeit(lambda X: tracking_step_jit(X, ys, params).tracking_error, Xs)
+    print(f"full tracking_step:  {full*1e3:8.2f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,112 @@
+"""Isolate the TPU cost of each woodbury-path ingredient."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import functools
+
+from porqua_tpu.profiling import measure_steady_state
+from porqua_tpu.qp.solve import SolverParams
+from porqua_tpu.tracking import synthetic_universe_np, tracking_step
+
+B, T, N = 252, 252, 500
+K_ROWS = T + 1
+
+amortized = functools.partial(measure_steady_state, k=4, return_floor=True)
+
+
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} {dev.device_kind}", flush=True)
+    Xs_np, ys_np = synthetic_universe_np(seed=42, n_dates=B, window=T,
+                                         n_assets=N)
+    Xs, ys = jnp.asarray(Xs_np), jnp.asarray(ys_np)
+    hp = jax.lax.Precision.HIGHEST
+
+    key = jax.random.PRNGKey(0)
+    V = jax.random.normal(key, (B, K_ROWS, N), jnp.float32) * 0.1
+    Dv = jnp.abs(jax.random.normal(key, (B, N), jnp.float32)) + 0.5
+
+    def s_assemble(V):
+        Vd = V * (1.0 / Dv)[:, None, :]
+        S = jnp.eye(K_ROWS)[None] + jnp.einsum(
+            "bkn,bjn->bkj", Vd, V, precision=hp)
+        return jnp.sum(S)
+    per, _ = amortized(s_assemble, V)
+    print(f"S assembly (b,{K_ROWS},{N}):     {per*1e3:8.2f} ms", flush=True)
+
+    def full_Vd(V):
+        Vd = V * (1.0 / Dv)[:, None, :]
+        return jnp.eye(K_ROWS)[None] + jnp.einsum(
+            "bkn,bjn->bkj", Vd, V, precision=hp)
+    S = jax.jit(full_Vd)(V)
+    jax.block_until_ready(S)
+
+    per, _ = amortized(lambda S: jnp.sum(jnp.linalg.cholesky(S)), S)
+    print(f"chol(S) {K_ROWS}:                {per*1e3:8.2f} ms", flush=True)
+
+    L = jax.jit(jnp.linalg.cholesky)(S)
+    jax.block_until_ready(L)
+    from jax.scipy.linalg import solve_triangular
+
+    per, _ = amortized(lambda L: jnp.sum(jax.vmap(
+        lambda Li: solve_triangular(Li, jnp.eye(K_ROWS), lower=True))(L)), L)
+    print(f"trinv(S) {K_ROWS}:               {per*1e3:8.2f} ms", flush=True)
+
+    Linv = jax.jit(lambda L: jax.vmap(
+        lambda Li: solve_triangular(Li, jnp.eye(K_ROWS), lower=True))(L))(L)
+    jax.block_until_ready(Linv)
+
+    def w_build(Linv):
+        Vd = V * (1.0 / Dv)[:, None, :]
+        return jnp.sum(jnp.einsum("bkj,bjn->bkn", Linv, Vd, precision=hp))
+    per, _ = amortized(w_build, Linv)
+    print(f"W build:                  {per*1e3:8.2f} ms", flush=True)
+
+    W = jax.jit(lambda Linv: jnp.einsum(
+        "bkj,bjn->bkn", Linv, V * (1.0 / Dv)[:, None, :], precision=hp))(Linv)
+    jax.block_until_ready(W)
+    rhs = jnp.ones((B, N), jnp.float32)
+
+    def apply25(W):
+        def body(i, x):
+            t = jnp.einsum("bkn,bn->bk", W, x, precision=hp)
+            x2 = x * (1.0 / Dv) - jnp.einsum("bkn,bk->bn", W, t, precision=hp)
+            # refinement: K x = D x + V'(V x)
+            kv = Dv * x2 + jnp.einsum(
+                "bkn,bk->bn", V,
+                jnp.einsum("bkn,bn->bk", V, x2, precision=hp), precision=hp)
+            r = x - kv
+            t2 = jnp.einsum("bkn,bn->bk", W, r, precision=hp)
+            return x2 + r * (1.0 / Dv) - jnp.einsum(
+                "bkn,bk->bn", W, t2, precision=hp)
+        return jnp.sum(jax.lax.fori_loop(0, 25, body, rhs))
+    per, _ = amortized(apply25, W)
+    print(f"25 woodbury applies:      {per*1e3:8.2f} ms", flush=True)
+
+    # tracking step variants
+    for ls in ("trinv", "woodbury"):
+        for pp in (0, 1):
+            params = SolverParams(max_iter=2000, eps_abs=1e-3, eps_rel=1e-3,
+                                  polish_passes=pp, linsolve=ls)
+
+            def stage(X):
+                out = tracking_step(X, ys, params)
+                return jnp.sum(out.tracking_error)
+            per, _ = amortized(stage, Xs, k=2)
+            out = jax.jit(lambda X: tracking_step(X, ys, params))(Xs)
+            print(f"tracking {ls:9s} polish={pp}: {per*1e3:8.2f} ms  "
+                  f"(median iters {float(jnp.median(out.iters)):.0f}, "
+                  f"TE {float(jnp.median(out.tracking_error)):.3e})",
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Chunked test runner: one pytest process per test file, retrying a file
+# once if the process dies with a signal (the XLA CPU compiler segfaults
+# sporadically on this image's single-core hosts — observed twice in
+# backend_compile_and_load at *different* tests, both clean on re-run).
+# A real test failure (rc=1) is NOT retried.
+set -u
+cd "$(dirname "$0")/.."
+fail=0
+for f in tests/test_*.py; do
+    for attempt in 1 2; do
+        out=$(timeout 1800 python -m pytest "$f" -q --no-header 2>&1)
+        rc=$?
+        tail_line=$(echo "$out" | grep -E "passed|failed|error|skipped" | tail -1)
+        if [ $rc -eq 0 ]; then
+            echo "OK   $f: $tail_line"
+            break
+        elif [ $rc -ge 128 ] && [ $attempt -eq 1 ]; then
+            echo "SIG  $f: died with rc=$rc (signal $((rc-128))), retrying"
+            continue
+        else
+            echo "FAIL $f (rc=$rc): $tail_line"
+            fail=1
+            break
+        fi
+    done
+done
+exit $fail
