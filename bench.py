@@ -152,11 +152,15 @@ def make_data_np():
 
 def _bench_polish_k(Xs, ys):
     """Capacitance dimension the polish actually uses on this workload
-    (None = dense path), straight from the gate in qp/polish.py."""
+    (None = dense path), straight from the gate in qp/polish.py.
+    eval_shape: the gate only reads static shapes — no device work."""
+    import jax
+
     from porqua_tpu.qp.polish import polish_capacitance_dim
     from porqua_tpu.tracking import build_tracking_qp
 
-    return polish_capacitance_dim(build_tracking_qp(Xs[0], ys[0]))
+    qp_shape = jax.eval_shape(build_tracking_qp, Xs[0], ys[0])
+    return polish_capacitance_dim(qp_shape)
 
 
 def device_child(platform: str) -> None:

@@ -48,7 +48,7 @@ def polish_capacitance_dim(qp: CanonicalQP):
 
 
 def _kkt_solve_factored(qp: CanonicalQP, params: SolverParams,
-                        aB, aC, bound_B, bound_C, q_eff):
+                        aB, aC, bound_B, bound_C, q_eff, delta):
     """Active-set KKT solve in the factored (Woodbury) regime.
 
     The penalty form the dense path uses (``M = P + dI + (1/d) actives``)
@@ -69,9 +69,7 @@ def _kkt_solve_factored(qp: CanonicalQP, params: SolverParams,
     """
     dtype = qp.P.dtype
     n, m = qp.n, qp.m
-    sigma = jnp.maximum(
-        jnp.asarray(params.polish_delta, dtype),
-        jnp.sqrt(jnp.asarray(jnp.finfo(dtype).eps, dtype)))
+    sigma = delta  # same clamped regularizer the dense path uses
     hp = jax.lax.Precision.HIGHEST
 
     pd = jnp.zeros(n, dtype) if qp.Pdiag is None else qp.Pdiag
@@ -88,29 +86,40 @@ def _kkt_solve_factored(qp: CanonicalQP, params: SolverParams,
 
     CaT = (qp.C * aC[:, None]).T                      # (n, m) masked rows
     Y = jax.vmap(psolve, in_axes=1, out_axes=1)(Z[:, None] * CaT)
-    G = aC[:, None] * jnp.dot(qp.C, Y, precision=hp) \
-        + jnp.diag(1.0 - aC)                           # (m, m)
+    G_raw = aC[:, None] * jnp.dot(qp.C, Y, precision=hp)  # (m, m)
+    # Degenerate active rows: if every variable a row touches is pinned
+    # (C_i Z == 0), its Schur diagonal is exactly 0 (SPD form) and G is
+    # singular. Drop such rows from the Schur system instead of letting
+    # linalg.solve emit inf/NaN — the pinned coordinates already carry
+    # the row's content, and a wrong guess is still caught by the
+    # accept-only-if-better test.
+    dead = (aC > 0) & (jnp.abs(jnp.diagonal(G_raw))
+                       <= 1e3 * jnp.finfo(dtype).eps)
+    aC_eff = aC * (1.0 - dead.astype(dtype))
+    Y = Y * aC_eff[None, :]
+    G = aC_eff[:, None] * jnp.dot(qp.C, Y, precision=hp) \
+        + jnp.diag(1.0 - aC_eff)
 
     def schur_step(rhs_z, r2):
         """Solve the projected KKT for (dx, dnu) given Z-space rhs and
-        the active-row residual r2 = aC (bound - C x)."""
+        the active-row residual r2 = aC_eff (bound - C x)."""
         b0 = psolve(rhs_z)
-        g = aC * jnp.dot(qp.C, b0, precision=hp) - r2
+        g = aC_eff * jnp.dot(qp.C, b0, precision=hp) - r2
         dnu = jnp.linalg.solve(G, g)
         dx = b0 - jnp.dot(Y, dnu, precision=hp)
         return dx, dnu
 
     x, nu = x_a, jnp.zeros(m, dtype)
     for _ in range(1 + params.polish_refine_steps):
-        s = apply_P(x) + q_eff + jnp.dot(aC * nu, qp.C, precision=hp)
-        r2 = aC * (bound_C - jnp.dot(qp.C, x, precision=hp))
+        s = apply_P(x) + q_eff + jnp.dot(aC_eff * nu, qp.C, precision=hp)
+        r2 = aC_eff * (bound_C - jnp.dot(qp.C, x, precision=hp))
         dx, dnu = schur_step(-Z * s, r2)
         x = x + dx
         nu = nu + dnu
 
     tau = -aB * (apply_P(x) + q_eff
-                 + jnp.dot(aC * nu, qp.C, precision=hp))
-    return x, aC * nu, tau
+                 + jnp.dot(aC_eff * nu, qp.C, precision=hp))
+    return x, aC_eff * nu, tau
 
 
 def polish(qp: CanonicalQP,
@@ -242,7 +251,7 @@ def polish(qp: CanonicalQP,
 
         if use_woodbury:
             return _kkt_solve_factored(
-                qp, params, aB_i, aC_i, bound_B_i, bound_C, q_eff_i)
+                qp, params, aB_i, aC_i, bound_B_i, bound_C, q_eff_i, delta)
         M = (
             qp.P + delta * eye_n
             + inv_d * ((qp.C.T * aC_i) @ qp.C + jnp.diag(aB_i))

@@ -130,6 +130,23 @@ def test_ridge_diag_flows_through():
                                rtol=0, atol=1e-8)
 
 
+def test_all_variables_pinned_degenerate_schur():
+    # ub = 1/n forces every variable to its bound, so the polish's
+    # active set pins ALL coordinates: C Z == 0 makes the budget row's
+    # Schur diagonal exactly zero. The dead-row guard must drop the row
+    # (not emit inf/NaN) and the solve must still land on the vertex.
+    dtype = jnp.float64
+    n = 16
+    Xs, ys = synthetic_universe(jax.random.PRNGKey(8), n_dates=1, window=30,
+                                n_assets=n, dtype=dtype)
+    qp = build_tracking_qp(Xs[0], ys[0], ub=1.0 / n)
+    sol = solve_qp(qp, _params("woodbury", dtype))
+    assert int(sol.status) == 1
+    assert bool(jnp.all(jnp.isfinite(sol.x)))
+    np.testing.assert_allclose(np.asarray(sol.x), np.full(n, 1.0 / n),
+                               rtol=0, atol=1e-9)
+
+
 def test_mesh_padding_keeps_factor_structure():
     from porqua_tpu.parallel.mesh import pad_batch_to_mesh
 
