@@ -93,12 +93,18 @@ def _kkt_solve_factored(qp: CanonicalQP, params: SolverParams,
     # linalg.solve emit inf/NaN — the pinned coordinates already carry
     # the row's content, and a wrong guess is still caught by the
     # accept-only-if-better test.
-    dead = (aC > 0) & (jnp.abs(jnp.diagonal(G_raw))
-                       <= 1e3 * jnp.finfo(dtype).eps)
+    # A truly-dead row's diagonal is exactly 0.0 (the Z mask is {0,1}),
+    # so the cutoff only needs to absorb roundoff in C K0^-1 C' —
+    # scale-relative, lest f32's ~1e-4 absolute band swallow a live row
+    # with small scaled sensitivity.
+    gdiag = jnp.abs(jnp.diagonal(G_raw))
+    dead = (aC > 0) & (gdiag <= 1e3 * jnp.finfo(dtype).eps
+                       * jnp.maximum(1.0, jnp.max(gdiag)))
     aC_eff = aC * (1.0 - dead.astype(dtype))
     Y = Y * aC_eff[None, :]
-    G = aC_eff[:, None] * jnp.dot(qp.C, Y, precision=hp) \
-        + jnp.diag(1.0 - aC_eff)
+    # aC_eff is a {0,1} subset of aC, so masking G_raw is exact — no
+    # second (m,n)@(n,m) matmul needed.
+    G = aC_eff[:, None] * G_raw * aC_eff[None, :] + jnp.diag(1.0 - aC_eff)
 
     def schur_step(rhs_z, r2):
         """Solve the projected KKT for (dx, dnu) given Z-space rhs and

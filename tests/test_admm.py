@@ -271,3 +271,26 @@ class TestBackendSelection:
             _w.simplefilter("always")
             solve_qp(qp, params)
         assert any("adaptive-rho clamp" in str(r.message) for r in rec)
+
+
+def test_blocked_triangular_inverse_matches_flat():
+    # The recursion must reproduce the flat n-step substitution to
+    # roundoff for awkward sizes (odd splits, below-threshold, batched).
+    import jax
+    import jax.numpy as jnp
+    from jax.scipy.linalg import solve_triangular
+
+    from porqua_tpu.qp.admm import blocked_triangular_inverse
+
+    for n in (500, 253, 64):
+        A = jax.random.normal(jax.random.PRNGKey(n), (3, n, n),
+                              jnp.float64) * 0.1
+        K = jnp.einsum("bij,bkj->bik", A, A) + 0.5 * jnp.eye(n)
+        L = jnp.linalg.cholesky(K)
+        ref = jax.vmap(lambda Li: solve_triangular(
+            Li, jnp.eye(n, dtype=Li.dtype), lower=True))(L)
+        got = blocked_triangular_inverse(L)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=0, atol=1e-12)
+        # strictly lower-triangular output, zero upper block
+        assert float(jnp.max(jnp.abs(jnp.triu(got, k=1)))) == 0.0
