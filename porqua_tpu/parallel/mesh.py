@@ -51,6 +51,75 @@ def make_mesh(n_devices: Optional[int] = None,
     return Mesh(devices.reshape(tuple(shape)), axis_names)
 
 
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> int:
+    """Join (or no-op into) a multi-host JAX runtime; returns process count.
+
+    The reference scales across machines not at all (its NCCL/MPI-class
+    axis simply does not exist); here multi-host is the same SPMD
+    program over a bigger mesh. On Cloud TPU pods
+    ``jax.distributed.initialize()`` discovers everything from the
+    metadata server, so all arguments are optional; on other clusters
+    pass coordinator/process explicitly. Safe to call when already
+    initialized or on a single process (returns 1).
+    """
+    explicit_multihost = num_processes is not None and num_processes > 1
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except (RuntimeError, ValueError):
+        if explicit_multihost:
+            # A job that ASKED for N > 1 processes must not silently
+            # degrade into N independent single-process runs (each
+            # would solve the full batch alone) — propagate.
+            raise
+        # already initialized, or single-process context with no
+        # coordinator — both mean "proceed with what jax reports".
+    if explicit_multihost and jax.process_count() != num_processes:
+        raise RuntimeError(
+            f"requested num_processes={num_processes} but the runtime "
+            f"reports {jax.process_count()} — refusing to run a "
+            "silently-degraded fleet")
+    return jax.process_count()
+
+
+def make_multihost_mesh(axis_names: Tuple[str, ...] = ("hosts", "dates"),
+                        ici_per_host: Optional[int] = None) -> Mesh:
+    """Mesh for a multi-host fleet: slow axis over DCN, fast axis over ICI.
+
+    Every QP in a batch is independent, so sharding stays pure data
+    parallelism even across hosts — but the mesh's axis ORDER still
+    matters: the leading ("hosts") axis follows the inter-host (DCN)
+    topology and the trailing axis the intra-host ICI ring, so the one
+    collective in the program (the final result all-gather) does its
+    high-volume hops over ICI and crosses DCN once per host, not once
+    per chip. With one process (tests, single chip) this degenerates to
+    a (1, n_local) mesh running the identical program.
+    """
+    n_proc = max(jax.process_count(), 1)
+    devices = np.asarray(jax.devices())
+    local = ici_per_host or max(1, len(devices) // n_proc)
+    if len(devices) % local:
+        raise ValueError(
+            f"ici_per_host={local} must divide the device count "
+            f"({len(devices)}) evenly")
+    if ici_per_host is None and local * n_proc != len(devices):
+        raise ValueError(
+            f"{len(devices)} devices across {n_proc} processes is not "
+            "rectangular; pass ici_per_host explicitly")
+    if local > len(devices) // n_proc:
+        raise ValueError(
+            f"ici_per_host={local} exceeds the {len(devices) // n_proc} "
+            "chips attached to each host — the trailing axis would hop "
+            "DCN, defeating the ICI placement this mesh promises")
+    grid = devices.reshape((-1, local))
+    return Mesh(grid, axis_names)
+
+
 def batch_sharding(mesh: Mesh, rank: int, n_batch_axes: int = 1) -> NamedSharding:
     """Sharding for one field: batch axes on the mesh, the rest replicated."""
     spec = tuple(mesh.axis_names[:n_batch_axes]) + (None,) * (rank - n_batch_axes)

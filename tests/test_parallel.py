@@ -158,3 +158,34 @@ def test_scan_l1_grid_rejects_uneven_mesh(rng):
     mesh = make_mesh(8, axis_names=("bench",))
     with pytest.raises(ValueError, match="divide evenly"):
         solve_scan_l1_grid(grid, n, np.zeros((3, n)), 0.001, mesh=mesh)
+
+
+def test_multihost_mesh_single_process_degenerates():
+    # Single-process: the hybrid hosts x dates mesh collapses to
+    # (1, n_local) and solves a sharded batch identically to 1-D.
+    from porqua_tpu.parallel.mesh import init_distributed, make_multihost_mesh
+
+    from porqua_tpu.tracking import build_tracking_qp, synthetic_universe
+
+    assert init_distributed() == 1
+    mesh = make_multihost_mesh()
+    assert mesh.devices.shape == (1, len(jax.devices()))
+    assert mesh.axis_names == ("hosts", "dates")
+
+    Xs, ys = synthetic_universe(jax.random.PRNGKey(2), n_dates=8, window=24,
+                                n_assets=12, dtype=jnp.float64)
+    qp = jax.vmap(build_tracking_qp)(Xs, ys)
+    # shard dates over the trailing (ICI) axis, replicate over hosts
+    sharded = jax.tree.map(
+        lambda a: jax.device_put(
+            a, jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec("dates"))),
+        qp,
+    )
+    sol = solve_qp_batch(sharded, SolverParams(
+        max_iter=2000, eps_abs=1e-8, eps_rel=1e-8, linsolve="chol"))
+    assert np.all(np.asarray(sol.status) == 1)
+    ref = solve_qp_batch(qp, SolverParams(
+        max_iter=2000, eps_abs=1e-8, eps_rel=1e-8, linsolve="chol"))
+    np.testing.assert_allclose(np.asarray(sol.x), np.asarray(ref.x),
+                               rtol=0, atol=1e-12)
