@@ -253,9 +253,10 @@ def device_child(platform: str) -> None:
         check_interval=params.check_interval,
         scaling_iters=params.scaling_iters,
         pallas=False, polish_passes=params.polish_passes,
-        # linsolve="auto" resolves per backend: trinv on TPU, chol on
-        # the CPU fallback — the model must count what actually ran.
-        linsolve="trinv" if dev.platform == "tpu" else "chol",
+        # This benchmark's data is f32, and linsolve="auto" resolves f32
+        # to trinv on EVERY backend (the f32 cho_solve substitution
+        # stalls at this scale — resolve_linsolve) — count that.
+        linsolve="trinv",
         # The tracking QP carries its factor (P = 2 X'X), so the polish
         # runs the exact-pinning capacitance path when it pays; ask the
         # gate itself so the model counts exactly what ran.

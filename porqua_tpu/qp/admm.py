@@ -295,6 +295,15 @@ def resolve_linsolve(params: SolverParams, qp: CanonicalQP) -> str:
                 "Pallas segment; use backend='xla'")
         return "woodbury"
     if ls == "auto":
+        if jnp.dtype(qp.P.dtype) == jnp.float32:
+            # f32 chol substitution stalls ADMM at production scale:
+            # measured at n=500 (north-star shape) the cho_solve path's
+            # primal residual floors at ~5e-3 — above eps — on CPU,
+            # while the trinv apply (two HIGHEST-precision GEMVs with
+            # the inverted factor) converges in 25 iterations with the
+            # same K. f64 shows no such gap (both converge, chol is
+            # cheaper), so chol remains the f64 host default.
+            return "trinv"
         return "trinv" if jax.default_backend() == "tpu" else "chol"
     return ls
 

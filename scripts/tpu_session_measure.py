@@ -1,0 +1,70 @@
+"""Round-2 TPU measurement batch, with tunnel-flap retries.
+
+Retries TPU init for up to RETRIES minutes (the axon tunnel drops and
+returns on its own schedule), then runs: north-star steady-state at
+B=252 and B=1008 (batch-scaling evidence + blocked-trinv gain).
+"""
+import os
+import subprocess
+import sys
+import time
+
+RETRIES = int(os.environ.get("TPU_RETRIES", 30))
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CHILD = r'''
+import sys; sys.path.insert(0, __REPO_ROOT__)
+import jax, jax.numpy as jnp, numpy as np
+dev = jax.devices()[0]
+assert dev.platform == "tpu", dev
+from porqua_tpu.profiling import measure_steady_state
+from porqua_tpu.qp.solve import SolverParams
+from porqua_tpu.tracking import synthetic_universe_np, tracking_step
+
+params = SolverParams(max_iter=2000, eps_abs=1e-3, eps_rel=1e-3,
+                      polish_passes=1, scaling_iters=4)
+for B in (252, 1008):
+    Xs_np, ys_np = synthetic_universe_np(seed=42, n_dates=B, window=252,
+                                         n_assets=500)
+    Xs, ys = jnp.asarray(Xs_np), jnp.asarray(ys_np)
+    out = jax.jit(lambda X: tracking_step(X, ys, params))(Xs)
+    solved = int(jnp.sum(out.status == 1))
+    per = measure_steady_state(
+        lambda X: jnp.sum(tracking_step(X, ys, params).tracking_error),
+        Xs, k=3)
+    print(f"RESULT B={B}: {per*1e3:.1f} ms = {per/B*1e6:.1f} us/date, "
+          f"solved {solved}/{B}, "
+          f"TE {float(jnp.median(out.tracking_error)):.4e}", flush=True)
+'''
+
+
+def main():
+    child = CHILD.replace("__REPO_ROOT__", repr(_ROOT))
+    for attempt in range(RETRIES):
+        try:
+            proc = subprocess.run([sys.executable, "-c", child],
+                                  capture_output=True, text=True,
+                                  timeout=1500)
+        except subprocess.TimeoutExpired:
+            print(f"attempt {attempt + 1}/{RETRIES} hung (1500s); "
+                  "retrying in 60s", flush=True)
+            time.sleep(60)
+            continue
+        out = proc.stdout + proc.stderr
+        if proc.returncode == 0 and "RESULT" in out:
+            # Echo RESULT lines only from the successful attempt —
+            # partial runs would otherwise emit duplicate, conflicting
+            # measurements for the same config across retries.
+            for line in out.splitlines():
+                if line.startswith("RESULT"):
+                    print(line, flush=True)
+            return
+        print(f"attempt {attempt + 1}/{RETRIES} failed "
+              f"(rc={proc.returncode}); retrying in 60s", flush=True)
+        time.sleep(60)
+    print("TPU never became available", flush=True)
+
+
+if __name__ == "__main__":
+    main()

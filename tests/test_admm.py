@@ -294,3 +294,28 @@ def test_blocked_triangular_inverse_matches_flat():
                                    rtol=0, atol=1e-12)
         # strictly lower-triangular output, zero upper block
         assert float(jnp.max(jnp.abs(jnp.triu(got, k=1)))) == 0.0
+
+
+def test_f32_auto_resolves_to_trinv_at_scale():
+    # Production-scale f32 regression: the cho_solve substitution's f32
+    # error floor (~5e-3 primal at n=500) stalls ADMM above eps, while
+    # the trinv apply converges in one segment. "auto" must therefore
+    # pick trinv for f32 on every backend — this solves the same
+    # problem the chol path measurably cannot.
+    import jax
+
+    from porqua_tpu.qp.admm import resolve_linsolve
+    from porqua_tpu.tracking import build_tracking_qp, synthetic_universe_np
+
+    Xs_np, ys_np = synthetic_universe_np(seed=42, n_dates=2, window=252,
+                                         n_assets=500)
+    Xs = jnp.asarray(Xs_np, jnp.float32)
+    ys = jnp.asarray(ys_np, jnp.float32)
+    qp = jax.vmap(build_tracking_qp)(Xs, ys)
+    params = SolverParams(max_iter=2000, eps_abs=1e-3, eps_rel=1e-3,
+                          polish_passes=1, scaling_iters=4)
+    assert resolve_linsolve(
+        params, jax.tree.map(lambda a: a[0], qp)) == "trinv"
+    sol = solve_qp_batch(qp, params)
+    assert np.all(np.asarray(sol.status) == 1), np.asarray(sol.status)
+    assert np.all(np.asarray(sol.iters) <= 100)
