@@ -24,7 +24,7 @@ from porqua_tpu.tracking import synthetic_universe_np, tracking_step
 
 params = SolverParams(max_iter=2000, eps_abs=1e-3, eps_rel=1e-3,
                       polish_passes=1, scaling_iters=4)
-for B in (252, 1008):
+for B in (int(sys.argv[1]),):
     Xs_np, ys_np = synthetic_universe_np(seed=42, n_dates=B, window=252,
                                          n_assets=500)
     Xs, ys = jnp.asarray(Xs_np), jnp.asarray(ys_np)
@@ -39,15 +39,15 @@ for B in (252, 1008):
 '''
 
 
-def main():
-    child = CHILD.replace("__REPO_ROOT__", repr(_ROOT))
+def _measure(child, b):
+    """One config, retried; returns True on success."""
     for attempt in range(RETRIES):
         try:
-            proc = subprocess.run([sys.executable, "-c", child],
+            proc = subprocess.run([sys.executable, "-c", child, str(b)],
                                   capture_output=True, text=True,
                                   timeout=1500)
         except subprocess.TimeoutExpired:
-            print(f"attempt {attempt + 1}/{RETRIES} hung (1500s); "
+            print(f"B={b} attempt {attempt + 1}/{RETRIES} hung (1500s); "
                   "retrying in 60s", flush=True)
             time.sleep(60)
             continue
@@ -59,11 +59,19 @@ def main():
             for line in out.splitlines():
                 if line.startswith("RESULT"):
                     print(line, flush=True)
-            return
-        print(f"attempt {attempt + 1}/{RETRIES} failed "
+            return True
+        print(f"B={b} attempt {attempt + 1}/{RETRIES} failed "
               f"(rc={proc.returncode}); retrying in 60s", flush=True)
         time.sleep(60)
-    print("TPU never became available", flush=True)
+    print(f"B={b}: TPU never became available", flush=True)
+    return False
+
+
+def main():
+    child = CHILD.replace("__REPO_ROOT__", repr(_ROOT))
+    for b in (252, 1008):
+        if not _measure(child, b):
+            break
 
 
 if __name__ == "__main__":
