@@ -293,13 +293,18 @@ def _spawn_child(platform: str):
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)  # child decides via argv
     cmd = [sys.executable, os.path.abspath(__file__), "--device-child", platform]
+    # The CPU fallback is the last line of defense: on a single-core
+    # host the full-size batch compiles + runs in minutes, so give it
+    # double the TPU budget rather than letting the same timeout that
+    # bounds a hung tunnel also kill the measurement that replaces it.
+    timeout_s = CHILD_TIMEOUT if platform == "tpu" else 2 * CHILD_TIMEOUT
     try:
         proc = subprocess.run(
-            cmd, capture_output=True, text=True, timeout=CHILD_TIMEOUT,
+            cmd, capture_output=True, text=True, timeout=timeout_s,
             env=env, cwd=os.path.dirname(os.path.abspath(__file__)) or ".",
         )
     except subprocess.TimeoutExpired:
-        raise RuntimeError(f"{platform} child timed out after {CHILD_TIMEOUT}s")
+        raise RuntimeError(f"{platform} child timed out after {timeout_s}s")
     for line in proc.stderr.splitlines():
         log(f"  [{platform}-child] {line}")
     if proc.returncode != 0:

@@ -100,7 +100,10 @@ class SolverParams:
     #             resolve_linsolve) — the factored structure is instead
     #             exploited by the polish, unconditionally, whenever
     #             qp.Pf is present (qp.polish._kkt_solve_factored).
-    # "auto"    — "trinv" on TPU, "chol" elsewhere.
+    # "auto"    — "trinv" for f32 on every backend (the f32 cho_solve
+    #             substitution stalls at production scale, see
+    #             resolve_linsolve); f64: "trinv" on TPU, "chol"
+    #             elsewhere.
     linsolve: str = "auto"
     # Inner iterative-refinement steps of the Woodbury apply (residual
     # via the factor form, two extra matvec pairs each). 1 restores
