@@ -128,6 +128,7 @@ def main():
             grid, n_assets=n, w_init=np.full((n_dev, n), 1.0 / n),
             transaction_cost=TC, mesh=mesh,
             params=SolverParams(eps_abs=1e-8, eps_rel=1e-8, max_iter=20000),
+            universes=problems.universes[:t_demo],
         )
         holder["value"] = grid_sols.x
     dgrid = float(np.abs(np.asarray(grid_sols.x)

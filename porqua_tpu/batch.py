@@ -123,12 +123,34 @@ def solve_batch(problems: BatchProblems,
                           l1_center=problems.l1_center)
 
 
+# Sentinel for scan-coupled entry points: the caller attests that every
+# date's problem was built over one identically-ordered asset universe
+# (e.g. synthetic batches built by construction). Use the real per-date
+# universe lists (``BatchProblems.universes``) whenever they exist.
+FIXED_UNIVERSE = "attested-fixed"
+
+
 def _require_fixed_universe(universes) -> None:
     """Both scan paths carry holdings positionally: variable j must mean
     the same asset on every date, or costs/bounds bind across unrelated
-    assets. Raise when per-date universes differ."""
+    assets. Raise when per-date universes differ — and raise on None:
+    the check is non-optional (round-2 verdict: the footgun was
+    reachable by the natural call). Pass :data:`FIXED_UNIVERSE` to
+    attest a by-construction fixed universe."""
     if universes is None:
-        return
+        raise ValueError(
+            "scan-coupled solves carry holdings positionally, so they "
+            "require the per-date asset universes to verify variable j "
+            "means the same asset on every date. Pass "
+            "universes=problems.universes (from BatchProblems), or "
+            "porqua_tpu.batch.FIXED_UNIVERSE to attest the batch was "
+            "built over one identically-ordered universe.")
+    if isinstance(universes, str):
+        if universes == FIXED_UNIVERSE:
+            return
+        raise ValueError(
+            f"unknown universes attestation {universes!r}; expected "
+            f"per-date asset lists or porqua_tpu.batch.FIXED_UNIVERSE")
     first = list(universes[0])
     for i, uni in enumerate(universes):
         if list(uni) != first:
@@ -144,8 +166,8 @@ def solve_scan_turnover(qp: CanonicalQP,
                         row_start: int,
                         w_init: jax.Array,
                         params: SolverParams = SolverParams(),
-                        universes: Optional[Sequence[Sequence[str]]] = None
-                        ) -> QPSolution:
+                        *,
+                        universes: Sequence[Sequence[str]]) -> QPSolution:
     """Pass 2, turnover-coupled dates: ``lax.scan`` with warm starts.
 
     When a turnover constraint chains dates through the previous
@@ -160,8 +182,10 @@ def solve_scan_turnover(qp: CanonicalQP,
 
     ``qp`` is a stacked batch (leading axis = dates) built with
     placeholder x0 = 0; ``w_init`` is the pre-backtest holdings vector
-    (zeros for a cash start). Pass ``universes`` (per-date asset lists)
-    to have the fixed-universe precondition checked.
+    (zeros for a cash start). ``universes`` (required): the per-date
+    asset lists, or :data:`FIXED_UNIVERSE` to attest a by-construction
+    fixed universe — the positional-carry precondition is checked, not
+    optional.
     """
     _require_fixed_universe(universes)
     n = n_assets
@@ -196,7 +220,8 @@ def solve_scan_l1(qp: CanonicalQP,
                   w_init: jax.Array,
                   transaction_cost: float,
                   params: SolverParams = SolverParams(),
-                  universes: Optional[Sequence[Sequence[str]]] = None) -> QPSolution:
+                  *,
+                  universes: Sequence[Sequence[str]]) -> QPSolution:
     """Turnover-cost-coupled dates via ``lax.scan`` with the native prox.
 
     The sequential analog of :func:`solve_scan_turnover` for the
@@ -212,11 +237,12 @@ def solve_scan_l1(qp: CanonicalQP,
     the SAME, identically-ordered asset universe: the carry is
     positional, so variable j must mean the same asset on every date —
     a date-varying selection would charge costs between unrelated
-    assets. Pass ``universes`` (the per-date asset lists from
-    :class:`BatchProblems`) to have this checked; build with a fixed
-    universe, masking exits via lb = ub = 0, when chaining costs.
-    ``w_init`` is the pre-backtest holdings vector (zeros for a cash
-    start), padded to the problem's n.
+    assets. ``universes`` (required): the per-date asset lists from
+    :class:`BatchProblems`, or :data:`FIXED_UNIVERSE` to attest a
+    by-construction fixed universe; build with a fixed universe,
+    masking exits via lb = ub = 0, when chaining costs. ``w_init`` is
+    the pre-backtest holdings vector (zeros for a cash start), padded
+    to the problem's n.
     """
     _require_fixed_universe(universes)
     dtype = qp.P.dtype
@@ -260,8 +286,8 @@ def solve_scan_l1_grid(qp_grid: CanonicalQP,
                        transaction_cost: float,
                        params: SolverParams = SolverParams(),
                        mesh=None,
-                       universes: Optional[Sequence[Sequence[str]]] = None
-                       ) -> QPSolution:
+                       *,
+                       universes: Sequence[Sequence[str]]) -> QPSolution:
     """Turnover-cost backtests for a whole benchmark/strategy grid:
     ``lax.scan`` over the coupled dates axis x ``vmap`` over benchmarks,
     optionally sharded over a device mesh.

@@ -42,12 +42,20 @@ class OptimizationParameter(dict):
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
         self.__dict__ = self
-        if not self.get("solver_name"):
+        # Key-presence checks: an explicit falsy value (solver_name="",
+        # allow_suboptimal=False, verbose=False) must survive — the
+        # reference's truthiness-based defaulting silently re-defaults
+        # them, which is exactly the dict-config looseness the typed
+        # SolverParams retires. ``allow_suboptimal`` is deliberately
+        # NOT materialized here: absent reads as falsy (strict success
+        # semantics) via ``.get()``, and key presence then faithfully
+        # records that the caller set it — which lets strategy classes
+        # with a different default (LAD) distinguish "caller said
+        # False" from "caller said nothing".
+        if "solver_name" not in self:
             self["solver_name"] = "jax_admm"
-        if self.get("verbose") is None:
+        if "verbose" not in self:
             self["verbose"] = True
-        if not self.get("allow_suboptimal"):
-            self["allow_suboptimal"] = False
 
     def to_solver_params(self) -> SolverParams:
         fields = {k: self[k] for k in _SOLVER_KEYS if k in self}
@@ -335,6 +343,20 @@ class LAD(Optimization):
         super().__init__(**kwargs)
         self.params["use_level"] = self.params.get("use_level", True)
         self.params["use_log"] = self.params.get("use_log", True)
+        # An LP in epigraph form run through first-order ADMM reaches
+        # LP-grade accuracy via the polish but rarely meets a tight QP
+        # eps in-loop, so MAX_ITER-with-good-polish is the expected
+        # terminal state: accept it by default (the reference defines
+        # allow_suboptimal but never consults it — optimization.py:47;
+        # here it gates exactly this acceptance). Pass
+        # allow_suboptimal=False (as a kwarg or inside an explicit
+        # params object) for strict residual-based success; only a
+        # value the caller never supplied is upgraded.
+        explicit = ("allow_suboptimal" in kwargs
+                    or (kwargs.get("params") is not None
+                        and "allow_suboptimal" in kwargs["params"]))
+        if not explicit:
+            self.params["allow_suboptimal"] = True
 
     def set_objective(self, optimization_data: OptimizationData) -> None:
         X = optimization_data["return_series"]
@@ -347,18 +369,10 @@ class LAD(Optimization):
                 y = np.log(y)
         self.objective = Objective(X=X, y=y)
 
-    def solve(self) -> bool:
-        self.model_canonical()
-        solver_params = self.params.to_solver_params()
-        sol = solve_qp(self.model, solver_params)
-        self.solution = sol
-        weights = pd.Series(
-            np.asarray(sol.x[: len(self.constraints.selection)]),
-            index=self.constraints.selection,
-        )
-        self.results = {"weights": weights.to_dict(),
-                        "status": bool(sol.status == Status.SOLVED)}
-        return True
+    # solve() is inherited: the base solve_jax already runs
+    # model_canonical (this class's epigraph lowering), applies the
+    # allow_suboptimal MAX_ITER acceptance (defaulted True above), and
+    # Nones the weights on failure — one copy of the acceptance logic.
 
     def canonical_parts(self) -> dict:
         X = to_numpy(self.objective["X"])
@@ -474,5 +488,21 @@ class PercentilePortfolios(Optimization):
         weights = pd.Series(0.0, index=scores.index)
         weights[w_dict[1].index] = 1.0 / max(len(w_dict[1]), 1)
         weights[w_dict[N].index] = -1.0 / max(len(w_dict[N]), 1)
-        self.results = {"weights": weights.to_dict(), "w_dict": w_dict}
+        # Parity with the reference's results contract: the dict always
+        # carries "status" (reference ``optimization.py:86-87``) so
+        # Backtest.run's prev-weights bookkeeping fires, and an
+        # "objective" value (the long-short raw-score spread between the
+        # top and bottom buckets) so ``append_custom``'s default
+        # "objective" key records something meaningful (reference
+        # ``backtest.py:245-270``). ``scores`` here are negated, so the
+        # raw-score spread is mean(-s | bucket 1) - mean(-s | bucket N).
+        raw = -vals
+        top, bot = raw[buckets == 1], raw[buckets == N]
+        # Degenerate score distributions can leave a bucket empty (the
+        # weights code above guards the same case); spread is 0 then,
+        # not NaN.
+        spread = (float(top.mean() - bot.mean())
+                  if top.size and bot.size else 0.0)
+        self.results = {"weights": weights.to_dict(), "w_dict": w_dict,
+                        "status": True, "objective": spread}
         return True

@@ -21,6 +21,7 @@ a single ``NamedSharding`` spec per rank suffices.
 
 from __future__ import annotations
 
+import warnings
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -65,25 +66,29 @@ def init_distributed(coordinator_address: Optional[str] = None,
     initialized or on a single process (returns 1).
     """
     explicit_multihost = num_processes is not None and num_processes > 1
+    init_error = None
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id,
         )
-    except (RuntimeError, ValueError):
-        if explicit_multihost:
-            # A job that ASKED for N > 1 processes must not silently
-            # degrade into N independent single-process runs (each
-            # would solve the full batch alone) — propagate.
-            raise
-        # already initialized, or single-process context with no
-        # coordinator — both mean "proceed with what jax reports".
+    except (RuntimeError, ValueError) as e:
+        # Already initialized, or single-process context with no
+        # coordinator — both mean "proceed with what jax reports". For
+        # the explicit multi-host case, fall through to the consistency
+        # check below: a second call on an already-initialized runtime
+        # with a MATCHING process count is the documented idempotent
+        # no-op; only a mismatch (a job that asked for N > 1 but is
+        # running as something else — N independent single-process runs
+        # would each solve the full batch alone) is an error.
+        init_error = e
     if explicit_multihost and jax.process_count() != num_processes:
         raise RuntimeError(
             f"requested num_processes={num_processes} but the runtime "
             f"reports {jax.process_count()} — refusing to run a "
-            "silently-degraded fleet")
+            "silently-degraded fleet"
+            + (f" (initialize said: {init_error})" if init_error else ""))
     return jax.process_count()
 
 
@@ -116,6 +121,33 @@ def make_multihost_mesh(axis_names: Tuple[str, ...] = ("hosts", "dates"),
             f"ici_per_host={local} exceeds the {len(devices) // n_proc} "
             "chips attached to each host — the trailing axis would hop "
             "DCN, defeating the ICI placement this mesh promises")
+    if local * n_proc == len(devices) and n_proc > 1:
+        # Consult physical topology where JAX can: with
+        # process_is_granule=True the hybrid helper groups the DCN axis
+        # by process (the "hosts" semantics this mesh promises — the
+        # default granule is the ICI slice, which on a multi-host
+        # single-slice pod would reject the shape) and orders each
+        # host's chips along the ICI fabric, which device-id order
+        # alone does not guarantee on pods.
+        try:
+            from jax.experimental import mesh_utils
+
+            grid = mesh_utils.create_hybrid_device_mesh(
+                (1, local), (n_proc, 1), devices=list(devices),
+                process_is_granule=True,
+            ).reshape((n_proc, local))
+            return Mesh(grid, axis_names)
+        except Exception as e:
+            warnings.warn(
+                f"topology-aware hybrid mesh unavailable ({e}); falling "
+                "back to device-id order — collective placement is "
+                "best-effort", stacklevel=2)
+    # Best-effort fallback (and the single-process path): device-id
+    # order is assumed to group chips by process (true for
+    # jax.devices() on current runtimes). With ici_per_host <
+    # chips/host this splits hosts into multiple rows — correctness is
+    # unaffected (pure data parallelism), only the collective-placement
+    # benefit is approximate.
     grid = devices.reshape((-1, local))
     return Mesh(grid, axis_names)
 

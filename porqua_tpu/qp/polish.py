@@ -82,7 +82,8 @@ def _kkt_solve_factored(qp: CanonicalQP, params: SolverParams,
 
     Dt = aB + sigma + pd * Z
     V = jnp.sqrt(jnp.asarray(2.0, dtype)) * qp.Pf * Z[None, :]
-    psolve = factored_spd_solve_operator(Dt, V, refine_steps=1)
+    psolve = factored_spd_solve_operator(
+        Dt, V, refine_steps=params.woodbury_refine)
 
     CaT = (qp.C * aC[:, None]).T                      # (n, m) masked rows
     Y = jax.vmap(psolve, in_axes=1, out_axes=1)(Z[:, None] * CaT)
@@ -95,11 +96,14 @@ def _kkt_solve_factored(qp: CanonicalQP, params: SolverParams,
     # accept-only-if-better test.
     # A truly-dead row's diagonal is exactly 0.0 (the Z mask is {0,1}),
     # so the cutoff only needs to absorb roundoff in C K0^-1 C' —
-    # scale-relative, lest f32's ~1e-4 absolute band swallow a live row
-    # with small scaled sensitivity.
+    # scale-relative with NO absolute floor: flooring the scale at 1
+    # would turn the cutoff into ~1e-4 absolute (f32) whenever every
+    # Schur diagonal sits below 1, dropping live rows with uniformly
+    # small scaled sensitivity. When max(gdiag) == 0 every active row's
+    # diagonal is exactly zero and `<= 0` still classifies them dead.
     gdiag = jnp.abs(jnp.diagonal(G_raw))
     dead = (aC > 0) & (gdiag <= 1e3 * jnp.finfo(dtype).eps
-                       * jnp.maximum(1.0, jnp.max(gdiag)))
+                       * jnp.max(gdiag))
     aC_eff = aC * (1.0 - dead.astype(dtype))
     Y = Y * aC_eff[None, :]
     # aC_eff is a {0,1} subset of aC, so masking G_raw is exact — no

@@ -109,7 +109,8 @@ def test_scan_l1_grid_sharded_matches_per_column(rng):
     scan-over-dates x vmap-over-benchmarks design)."""
     import jax.numpy as jnp
 
-    from porqua_tpu.batch import solve_scan_l1, solve_scan_l1_grid
+    from porqua_tpu.batch import (FIXED_UNIVERSE, solve_scan_l1,
+                                  solve_scan_l1_grid)
 
     B, T, n = 4, 6, 8
     tc = 0.002
@@ -132,15 +133,18 @@ def test_scan_l1_grid_sharded_matches_per_column(rng):
 
     mesh = make_mesh(4, axis_names=("bench",))
     sharded = solve_scan_l1_grid(
-        grid, n, w_init, tc, params=params, mesh=mesh)
+        grid, n, w_init, tc, params=params, mesh=mesh,
+        universes=FIXED_UNIVERSE)
     unsharded = solve_scan_l1_grid(
-        grid, n, w_init, tc, params=params, mesh=None)
+        grid, n, w_init, tc, params=params, mesh=None,
+        universes=FIXED_UNIVERSE)
     np.testing.assert_allclose(
         np.asarray(sharded.x), np.asarray(unsharded.x), atol=1e-10)
 
     for b in range(B):
         col = jax.tree.map(lambda a: a[b], grid)
-        ref = solve_scan_l1(col, n, w_init[b], tc, params=params)
+        ref = solve_scan_l1(col, n, w_init[b], tc, params=params,
+                            universes=FIXED_UNIVERSE)
         assert np.all(np.asarray(ref.status) == Status.SOLVED)
         np.testing.assert_allclose(
             np.asarray(sharded.x[b]), np.asarray(ref.x), atol=1e-9)
@@ -156,8 +160,10 @@ def test_scan_l1_grid_rejects_uneven_mesh(rng):
     grid = jax.tree.map(
         lambda a: jnp.broadcast_to(a, (3, 2) + a.shape), qp)
     mesh = make_mesh(8, axis_names=("bench",))
+    from porqua_tpu.batch import FIXED_UNIVERSE
     with pytest.raises(ValueError, match="divide evenly"):
-        solve_scan_l1_grid(grid, n, np.zeros((3, n)), 0.001, mesh=mesh)
+        solve_scan_l1_grid(grid, n, np.zeros((3, n)), 0.001, mesh=mesh,
+                           universes=FIXED_UNIVERSE)
 
 
 def test_multihost_mesh_single_process_degenerates():

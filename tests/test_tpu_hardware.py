@@ -68,7 +68,9 @@ def test_pallas_segment_matches_xla_iterations_on_hardware(rng):
     scaled, scaling = equilibrate(qp, iters=10)
     n, m = scaled.n, scaled.m
     dtype = scaled.P.dtype
-    rho = jnp.full((m,), 100.0, dtype)  # budget row is an equality: 1e3 * 0.1
+    # Arbitrary per-row step size (both paths receive the same vector;
+    # this is a kernel-parity test, not a convergence test).
+    rho = jnp.full((m,), 100.0, dtype)
     rho_b = jnp.full((n,), 0.1, dtype)
     # 5 iterations: enough to exercise the fused segment end-to-end on
     # hardware while keeping f32 op-ordering drift (pallas vs XLA emit
