@@ -349,13 +349,11 @@ class LAD(Optimization):
         # terminal state: accept it by default (the reference defines
         # allow_suboptimal but never consults it — optimization.py:47;
         # here it gates exactly this acceptance). Pass
-        # allow_suboptimal=False (as a kwarg or inside an explicit
-        # params object) for strict residual-based success; only a
-        # value the caller never supplied is upgraded.
-        explicit = ("allow_suboptimal" in kwargs
-                    or (kwargs.get("params") is not None
-                        and "allow_suboptimal" in kwargs["params"]))
-        if not explicit:
+        # allow_suboptimal=False for strict residual-based success;
+        # only a value the caller never supplied is upgraded.
+        # OptimizationParameter materializes the key iff the caller set
+        # it, so key presence IS the explicitness record.
+        if "allow_suboptimal" not in self.params:
             self.params["allow_suboptimal"] = True
 
     def set_objective(self, optimization_data: OptimizationData) -> None:

@@ -1,19 +1,39 @@
-"""Round-2 TPU measurement batch, with tunnel-flap retries.
+"""Round-3 TPU measurement batch, probe-gated against tunnel flaps.
 
-Retries TPU init for up to RETRIES minutes (the axon tunnel drops and
-returns on its own schedule), then runs: north-star steady-state at
-B=252 and B=1008 (batch-scaling evidence + blocked-trinv gain).
+The axon tunnel black-holes rather than failing fast, so a hung full
+measurement burns its whole timeout (25 min in the round-2 version of
+this script). Round 3 gates every attempt behind a cheap probe child
+(``jax.devices()`` + one tiny dispatch, <=90 s): while the tunnel is
+down each cycle costs ~90 s + a 120 s sleep, and the full measurement
+only launches once a probe has just succeeded — catching the tunnel
+within a couple of minutes of it returning.
+
+Measures, per config: north-star steady-state at B=252 and B=1008
+(batch-scaling evidence + the blocked-trinv / polish-off gains), and
+the Pallas fused-segment crossover at n in {1000, 2000} (round-2
+verdict item 7).
 """
 import os
 import subprocess
 import sys
 import time
 
-RETRIES = int(os.environ.get("TPU_RETRIES", 30))
+RETRIES = int(os.environ.get("TPU_RETRIES", 200))
+PROBE_TIMEOUT = int(os.environ.get("TPU_PROBE_TIMEOUT", 90))
+SLEEP_S = int(os.environ.get("TPU_RETRY_SLEEP", 120))
+CHILD_TIMEOUT = int(os.environ.get("TPU_CHILD_TIMEOUT", 900))
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-CHILD = r'''
+PROBE = r'''
+import jax, numpy as np, jax.numpy as jnp
+dev = jax.devices()[0]
+assert dev.platform == "tpu", dev
+np.asarray(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+print("PROBEOK", dev.device_kind, flush=True)
+'''
+
+NORTHSTAR = r'''
 import sys; sys.path.insert(0, __REPO_ROOT__)
 import jax, jax.numpy as jnp, numpy as np
 dev = jax.devices()[0]
@@ -22,56 +42,129 @@ from porqua_tpu.profiling import measure_steady_state
 from porqua_tpu.qp.solve import SolverParams
 from porqua_tpu.tracking import synthetic_universe_np, tracking_step
 
+# Bench config (round 3): polish off, Ruiz x2 — see bench.py.
 params = SolverParams(max_iter=2000, eps_abs=1e-3, eps_rel=1e-3,
-                      polish_passes=1, scaling_iters=4)
-for B in (int(sys.argv[1]),):
-    Xs_np, ys_np = synthetic_universe_np(seed=42, n_dates=B, window=252,
-                                         n_assets=500)
-    Xs, ys = jnp.asarray(Xs_np), jnp.asarray(ys_np)
-    out = jax.jit(lambda X: tracking_step(X, ys, params))(Xs)
-    solved = int(jnp.sum(out.status == 1))
-    per = measure_steady_state(
-        lambda X: jnp.sum(tracking_step(X, ys, params).tracking_error),
-        Xs, k=3)
-    print(f"RESULT B={B}: {per*1e3:.1f} ms = {per/B*1e6:.1f} us/date, "
-          f"solved {solved}/{B}, "
-          f"TE {float(jnp.median(out.tracking_error)):.4e}", flush=True)
+                      polish=False, scaling_iters=2)
+B = int(sys.argv[1])
+Xs_np, ys_np = synthetic_universe_np(seed=42, n_dates=B, window=252,
+                                     n_assets=500)
+Xs, ys = jnp.asarray(Xs_np), jnp.asarray(ys_np)
+out = jax.jit(lambda X: tracking_step(X, ys, params))(Xs)
+solved = int(jnp.sum(out.status == 1))
+per = measure_steady_state(
+    lambda X: jnp.sum(tracking_step(X, ys, params).tracking_error),
+    Xs, k=3)
+print(f"RESULT northstar B={B}: {per*1e3:.1f} ms = {per/B*1e6:.1f} us/date, "
+      f"solved {solved}/{B}, "
+      f"TE {float(jnp.median(out.tracking_error)):.4e}", flush=True)
+'''
+
+PALLAS_XOVER = r'''
+import sys; sys.path.insert(0, __REPO_ROOT__)
+import jax, jax.numpy as jnp, numpy as np
+dev = jax.devices()[0]
+assert dev.platform == "tpu", dev
+from porqua_tpu.profiling import measure_steady_state
+from porqua_tpu.qp.solve import SolverParams, solve_qp_batch
+from porqua_tpu.tracking import build_tracking_qp, synthetic_universe_np
+
+n = int(sys.argv[1])
+B = int(sys.argv[2]) if len(sys.argv) > 2 else 16
+Xs_np, ys_np = synthetic_universe_np(seed=7, n_dates=B, window=252,
+                                     n_assets=n)
+Xs, ys = jnp.asarray(Xs_np), jnp.asarray(ys_np)
+qps = jax.jit(jax.vmap(build_tracking_qp))(Xs, ys)
+jax.block_until_ready(qps.P)
+for backend in ("xla", "pallas"):
+    params = SolverParams(max_iter=2000, eps_abs=1e-3, eps_rel=1e-3,
+                          polish=False, scaling_iters=2, backend=backend,
+                          linsolve="trinv", vmem_limit_mb=64.0)
+    try:
+        out = jax.jit(lambda q: solve_qp_batch(q, params))(qps)
+        solved = int(jnp.sum(out.status == 1))
+        per = measure_steady_state(
+            lambda q: jnp.sum(solve_qp_batch(q, params).x), qps, k=3)
+        print(f"RESULT pallas-xover n={n} B={B} {backend}: {per*1e3:.1f} ms, "
+              f"solved {solved}/{B}, "
+              f"iters {float(jnp.median(out.iters)):.0f}", flush=True)
+    except Exception as e:
+        print(f"RESULT pallas-xover n={n} B={B} {backend}: FAILED "
+              f"{type(e).__name__}: {e}", flush=True)
 '''
 
 
-def _measure(child, b):
-    """One config, retried; returns True on success."""
-    for attempt in range(RETRIES):
-        try:
-            proc = subprocess.run([sys.executable, "-c", child, str(b)],
-                                  capture_output=True, text=True,
-                                  timeout=1500)
-        except subprocess.TimeoutExpired:
-            print(f"B={b} attempt {attempt + 1}/{RETRIES} hung (1500s); "
-                  "retrying in 60s", flush=True)
-            time.sleep(60)
-            continue
-        out = proc.stdout + proc.stderr
-        if proc.returncode == 0 and "RESULT" in out:
-            # Echo RESULT lines only from the successful attempt —
-            # partial runs would otherwise emit duplicate, conflicting
-            # measurements for the same config across retries.
-            for line in out.splitlines():
-                if line.startswith("RESULT"):
-                    print(line, flush=True)
-            return True
-        print(f"B={b} attempt {attempt + 1}/{RETRIES} failed "
-              f"(rc={proc.returncode}); retrying in 60s", flush=True)
-        time.sleep(60)
-    print(f"B={b}: TPU never became available", flush=True)
-    return False
+def _run(code, args, timeout):
+    """One child; returns (rc, combined output)."""
+    code = code.replace("__REPO_ROOT__", repr(_ROOT))
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code] + [str(a) for a in args],
+            capture_output=True, text=True, timeout=timeout)
+        return proc.returncode, proc.stdout + proc.stderr
+    except subprocess.TimeoutExpired:
+        return -1, f"(timed out after {timeout}s)"
+
+
+MAX_JOB_ATTEMPTS = int(os.environ.get("TPU_JOB_ATTEMPTS", 3))
 
 
 def main():
-    child = CHILD.replace("__REPO_ROOT__", repr(_ROOT))
-    for b in (252, 1008):
-        if not _measure(child, b):
+    # (code, args, timeout, n_results): B=1008 needed a 1500 s budget
+    # in round 2 (the tunnel moves data at MB/s); the rest fit in
+    # CHILD_TIMEOUT. n_results = RESULT lines a complete run prints
+    # (the xover child measures both backends).
+    jobs = [
+        (NORTHSTAR, [252], CHILD_TIMEOUT, 1),
+        (NORTHSTAR, [1008], max(CHILD_TIMEOUT, 1500), 1),
+        (PALLAS_XOVER, [1000, 16], CHILD_TIMEOUT, 2),
+        (PALLAS_XOVER, [2000, 8], CHILD_TIMEOUT, 2),
+    ]
+    done = [False] * len(jobs)
+    attempts = [0] * len(jobs)
+    for attempt in range(RETRIES):
+        if all(done):
             break
+        rc, out = _run(PROBE, [], PROBE_TIMEOUT)
+        if rc != 0 or "PROBEOK" not in out:
+            print(f"probe {attempt + 1}/{RETRIES}: tunnel down "
+                  f"({out.strip()[-120:]}); sleeping {SLEEP_S}s", flush=True)
+            time.sleep(SLEEP_S)
+            continue
+        print(f"probe OK: {out.strip()}", flush=True)
+        for i, (code, args, timeout, n_results) in enumerate(jobs):
+            if done[i]:
+                continue
+            if attempts[i] >= MAX_JOB_ATTEMPTS:
+                continue  # capped out; let the remaining jobs run
+            attempts[i] += 1
+            rc, out = _run(code, args, timeout)
+            result_lines = [ln for ln in out.splitlines()
+                            if ln.startswith("RESULT")]
+            for line in result_lines:
+                print(line, flush=True)
+
+            # Done only when the child exits cleanly with ALL expected
+            # RESULT lines, each either a real measurement or a
+            # *structural* failure (VMEM/lowering — the measured
+            # outcome for an oversized kernel config). A transient
+            # failure caught in-child (printed as 'RESULT ... FAILED')
+            # or a truncated line set is retried like any other error.
+            def line_ok(ln):
+                if "FAILED" not in ln:
+                    return True
+                return ("RESOURCE_EXHAUSTED" in ln
+                        or "vmem" in ln.lower() or "Mosaic" in ln)
+
+            if (rc == 0 and len(result_lines) >= n_results
+                    and all(line_ok(ln) for ln in result_lines)):
+                done[i] = True
+            else:
+                print(f"job {i} ({args}) attempt {attempts[i]}/"
+                      f"{MAX_JOB_ATTEMPTS} failed rc={rc}: "
+                      f"{out.strip()[-200:]}", flush=True)
+                break  # re-probe before burning more budget
+    print("SESSION MEASURE DONE:",
+          ", ".join(str(j[1]) for j, d in zip(jobs, done) if d), flush=True)
 
 
 if __name__ == "__main__":
