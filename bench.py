@@ -301,18 +301,19 @@ def device_child(platform: str, n_dates: int) -> None:
     jax.block_until_ready((Xs, ys))
 
     # f32 on device: run ADMM to a loose in-loop tolerance (the f32
-    # residual floor is ~1e-3). Round 3: with the equality-row step-size
-    # weighting removed from the defaults (rho_eq_scale 1.0 — the x1000
-    # weighting drove a ~1e-4 limit cycle, see BASELINE.md), in-loop
-    # f32 ADMM converges cleanly and the polish is no longer needed for
-    # tracking-error parity: measured TE median 6.1239e-4 with AND
-    # without polish vs the f64 CPU baseline's 6.139e-4, 25 iters/date
-    # either way — so the ~20 ms/batch polish stage is off here.
-    # scaling_iters=2: Ruiz converges on these Gram-matrix problems in
-    # a couple of sweeps (TE parity measured at 4, 2, and 1 sweeps;
-    # each extra sweep rereads the 252 MB P batch).
+    # residual floor is ~1e-3) and let one active-set polish pass land
+    # accuracy. Round 3 re-tested dropping the polish entirely (the
+    # equality-row limit cycle that made loose-eps iterates fragile is
+    # gone — see BASELINE.md): an 8-date sample showed TE parity, but
+    # the 32-date fallback run exposed a +2% median-TE drift without
+    # polish (6.27e-4 vs the f64 baseline's 6.14e-4) — some dates'
+    # loose-eps f32 iterates do still need the finish. Matched TE is
+    # the acceptance bar, so the ~20 ms polish stays. scaling_iters=2:
+    # Ruiz converges on these Gram-matrix problems in a couple of
+    # sweeps (TE parity measured at 4, 2, and 1 sweeps; each extra
+    # sweep rereads the 252 MB P batch).
     params = SolverParams(max_iter=2000, eps_abs=1e-3, eps_rel=1e-3,
-                          polish=False, scaling_iters=2)
+                          polish_passes=1, scaling_iters=2)
 
     t0 = time.perf_counter()
     out = tracking_step_jit(Xs, ys, params)

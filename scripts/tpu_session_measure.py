@@ -42,9 +42,10 @@ from porqua_tpu.profiling import measure_steady_state
 from porqua_tpu.qp.solve import SolverParams
 from porqua_tpu.tracking import synthetic_universe_np, tracking_step
 
-# Bench config (round 3): polish off, Ruiz x2 — see bench.py.
+# Bench config (round 3): 1-pass polish (TE parity), Ruiz x2 — see
+# bench.py. Also time the polish-off variant for the record.
 params = SolverParams(max_iter=2000, eps_abs=1e-3, eps_rel=1e-3,
-                      polish=False, scaling_iters=2)
+                      polish_passes=1, scaling_iters=2)
 B = int(sys.argv[1])
 Xs_np, ys_np = synthetic_universe_np(seed=42, n_dates=B, window=252,
                                      n_assets=500)
@@ -57,6 +58,18 @@ per = measure_steady_state(
 print(f"RESULT northstar B={B}: {per*1e3:.1f} ms = {per/B*1e6:.1f} us/date, "
       f"solved {solved}/{B}, "
       f"TE {float(jnp.median(out.tracking_error)):.4e}", flush=True)
+if B <= 252:
+    # Secondary: the polish-off variant, for the perf record (its TE
+    # drifts ~+2% on some dates — see bench.py — so it is not the
+    # headline config, but its timing bounds the polish cost).
+    pnop = SolverParams(max_iter=2000, eps_abs=1e-3, eps_rel=1e-3,
+                        polish=False, scaling_iters=2)
+    out2 = jax.jit(lambda X: tracking_step(X, ys, pnop))(Xs)
+    per2 = measure_steady_state(
+        lambda X: jnp.sum(tracking_step(X, ys, pnop).tracking_error),
+        Xs, k=3)
+    print(f"RESULT northstar-nopolish B={B}: {per2*1e3:.1f} ms, "
+          f"TE {float(jnp.median(out2.tracking_error)):.4e}", flush=True)
 '''
 
 PALLAS_XOVER = r'''
@@ -114,7 +127,7 @@ def main():
     # CHILD_TIMEOUT. n_results = RESULT lines a complete run prints
     # (the xover child measures both backends).
     jobs = [
-        (NORTHSTAR, [252], CHILD_TIMEOUT, 1),
+        (NORTHSTAR, [252], CHILD_TIMEOUT, 2),
         (NORTHSTAR, [1008], max(CHILD_TIMEOUT, 1500), 1),
         (PALLAS_XOVER, [1000, 16], CHILD_TIMEOUT, 2),
         (PALLAS_XOVER, [2000, 8], CHILD_TIMEOUT, 2),

@@ -168,3 +168,53 @@ def test_quarterly_sweep_all_windows_solve():
         if not ls.solve() or int(ls.solution.status) != Status.SOLVED:
             failed.append(d)
     assert not failed, f"unsolved windows: {failed}"
+
+
+def test_serial_and_batched_engines_agree_on_2020():
+    """Engine parity on real data through the COVID regime: the serial
+    warm-start-chained engine and the one-XLA-program batched engine
+    must produce the same weights on the 2020 quarterly backtest (the
+    drive that exposed the round-3 equality-row stall — back then the
+    two engines failed on *different* dates)."""
+    import pandas as pd
+
+    from porqua_tpu.backtest import Backtest, BacktestService
+    from porqua_tpu.batch import run_batch
+    from porqua_tpu.builders import (OptimizationItemBuilder,
+                                     SelectionItemBuilder,
+                                     bibfn_bm_series,
+                                     bibfn_box_constraints,
+                                     bibfn_budget_constraint,
+                                     bibfn_return_series,
+                                     bibfn_selection_data)
+
+    data = load_data_msci(path=DATA_PATH)
+    rebdates = [str(d.date()) for d in
+                pd.date_range("2020-01-01", "2020-12-31", freq="QS")]
+    bs = BacktestService(
+        data={"return_series": data["return_series"],
+              "bm_series": data["bm_series"]},
+        selection_item_builders={
+            "data": SelectionItemBuilder(bibfn=bibfn_selection_data)},
+        optimization_item_builders={
+            "rs": OptimizationItemBuilder(bibfn=bibfn_return_series,
+                                          width=252),
+            "bm": OptimizationItemBuilder(bibfn=bibfn_bm_series, width=252),
+            "budget": OptimizationItemBuilder(
+                bibfn=bibfn_budget_constraint, budget=1),
+            "box": OptimizationItemBuilder(bibfn=bibfn_box_constraints,
+                                           upper=0.5),
+        },
+        optimization=LeastSquares(),
+        settings={"rebdates": rebdates, "quiet": True},
+    )
+    bt = Backtest()
+    bt.run(bs)
+    W_serial = bt.strategy.get_weights_df()
+    W_batch = run_batch(bs).strategy.get_weights_df()
+
+    # Every date solves in both engines (weights sum to the budget)...
+    np.testing.assert_allclose(W_serial.sum(axis=1), 1.0, atol=1e-6)
+    np.testing.assert_allclose(W_batch.sum(axis=1), 1.0, atol=1e-6)
+    # ...and the engines agree to f32 solver tolerance.
+    assert float((W_serial - W_batch).abs().to_numpy().max()) < 1e-4
