@@ -44,6 +44,10 @@ def pytest_configure(config):
         "tpu: requires a real TPU backend (run with PORQUA_TPU_TESTS=1 "
         "pytest -m tpu); skipped otherwise",
     )
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-second subprocess tests (deselect with -m 'not slow')",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,89 @@
+"""Driver-contract tests for bench.py.
+
+The benchmark artifact failed to record in rounds 1 AND 2 (a TPU-init
+crash, then a blown wall-clock budget against a black-holed tunnel).
+These tests pin the round-3 contract: bench.py always prints exactly
+one parseable JSON line on stdout and exits 0 — under a forced-CPU run,
+and under a global deadline too short for any device work.
+
+Subprocess-based on purpose: the contract is about the executable the
+driver invokes, not about importable internals.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_BENCH = os.path.join(_ROOT, "bench.py")
+
+
+def _run_bench(env_extra, timeout):
+    env = dict(os.environ)
+    env.update(env_extra)
+    # The tests' own JAX_PLATFORMS must not leak: bench children decide
+    # their platform via argv.
+    proc = subprocess.run(
+        [sys.executable, _BENCH], capture_output=True, text=True,
+        timeout=timeout, env=env, cwd=_ROOT,
+    )
+    return proc
+
+
+def _parse_single_json_line(stdout):
+    lines = [ln for ln in stdout.strip().splitlines() if ln.strip()]
+    assert len(lines) == 1, f"expected exactly one stdout line, got {lines}"
+    return json.loads(lines[0])
+
+
+@pytest.mark.slow
+def test_forced_cpu_run_prints_valid_json():
+    proc = _run_bench({
+        "PORQUA_BENCH_PLATFORM": "cpu",
+        "PORQUA_BENCH_DATES": "6",
+        "PORQUA_BENCH_ASSETS": "32",
+        "PORQUA_BENCH_WINDOW": "48",
+        "PORQUA_BENCH_FALLBACK_DATES": "3",
+        "PORQUA_BENCH_DEADLINE": "240",
+    }, timeout=280)
+    assert proc.returncode == 0, proc.stderr[-500:]
+    payload = _parse_single_json_line(proc.stdout)
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in payload, f"missing {key}"
+    assert payload["value"] > 0
+    assert payload["device"] == "cpu"
+    assert payload["fallback_reduced"] is True
+    assert payload["fallback_dates"] == 3
+    # A healthy forced-cpu run is annotated via "note", never "error".
+    assert "error" not in payload
+    assert "forced" in payload.get("note", "")
+    # Quality fields present so speedups are falsifiable.
+    assert payload["device_solved"] == 3
+    assert payload["baseline_median_te"] > 0
+    assert payload["device_median_te"] > 0
+
+
+@pytest.mark.slow
+def test_deadline_still_prints_json():
+    """A deadline too short for any device stage must still produce the
+    JSON line (with the partial-results error), exit 0, and do so
+    within a few seconds of the deadline."""
+    proc = _run_bench({
+        "PORQUA_BENCH_PLATFORM": "cpu",
+        "PORQUA_BENCH_DATES": "6",
+        "PORQUA_BENCH_ASSETS": "32",
+        "PORQUA_BENCH_WINDOW": "48",
+        "PORQUA_BENCH_DEADLINE": "12",
+    }, timeout=60)
+    assert proc.returncode == 0, proc.stderr[-500:]
+    payload = _parse_single_json_line(proc.stdout)
+    assert "value" in payload and "vs_baseline" in payload
+    assert payload["elapsed_s"] < 30
+    # Either a stage was skipped for lack of budget or the alarm fired;
+    # both must be visible in the error field.
+    err = payload.get("error", "")
+    assert ("deadline" in err or "no time left" in err
+            or "no budget" in err), payload
