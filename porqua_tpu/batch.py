@@ -82,11 +82,19 @@ def build_problems(bs: BacktestService,
     m_max = max(p["C"].shape[0] for p in parts_list)
     n_assets_max = max(len(u) for u in universes)
 
+    # Carry the objective factor (P == 2 Pf' Pf + diag(Pdiag)) into the
+    # batch only when every date has one with the same row count —
+    # stacking requires a single static factor shape. A mixed batch
+    # (e.g. one date's problem lifted) falls back to dense P.
+    use_pf = (all("Pf" in p for p in parts_list)
+              and len({p["Pf"].shape[0] for p in parts_list}) == 1)
     qps = [
         CanonicalQP.build(
             p["P"], p["q"], C=p["C"], l=p["l"], u=p["u"],
             lb=p["lb"], ub=p["ub"], constant=p.get("constant", 0.0),
             n_max=n_max, m_max=m_max, dtype=dtype,
+            Pf=p["Pf"] if use_pf else None,
+            Pdiag=p.get("Pdiag") if use_pf else None,
         )
         for p in parts_list
     ]

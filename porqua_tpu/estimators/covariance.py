@@ -44,12 +44,20 @@ def cov_duv(X: jax.Array) -> jax.Array:
     return jnp.broadcast_to(eye, X.shape[:-2] + (n, n))
 
 
+def _sanitize_lambda(lambda_reg: Optional[float]) -> float:
+    """Shared ridge-intensity sanitization (None/NaN/negative -> 0) —
+    used by both the dense estimator and the factor form so the two
+    cannot drift."""
+    if lambda_reg is None or np.isnan(lambda_reg) or lambda_reg < 0:
+        return 0.0
+    return float(lambda_reg)
+
+
 def cov_linear_shrinkage(X: jax.Array, lambda_reg: Optional[float] = None) -> jax.Array:
     """Sample covariance + lambda * mean(sigma^2) * I ridge
     (reference ``covariance.py:71-84``)."""
     sigmat = cov_pearson(X)
-    if lambda_reg is None or np.isnan(lambda_reg) or lambda_reg < 0:
-        lambda_reg = 0.0
+    lambda_reg = _sanitize_lambda(lambda_reg)
     if lambda_reg > 0:
         d = sigmat.shape[-1]
         sig2 = jnp.diagonal(sigmat, axis1=-2, axis2=-1)
@@ -58,13 +66,10 @@ def cov_linear_shrinkage(X: jax.Array, lambda_reg: Optional[float] = None) -> ja
     return sigmat
 
 
-def cov_ledoit_wolf(X: jax.Array) -> jax.Array:
-    """Ledoit-Wolf (2004) shrinkage toward scaled identity.
-
-    Optimal shrinkage intensity estimated from the data; this is the
-    estimator BASELINE.json config 3 asks for ("Ledoit-Wolf covariance",
-    which the reference approximates with a fixed ridge).
-    """
+def ledoit_wolf_params(X: jax.Array):
+    """(shrink, mu, S): the Ledoit-Wolf intensity, identity-target scale,
+    and MLE sample covariance the shrunk estimate is assembled from —
+    shared by the dense estimator and the factor form."""
     T, n = X.shape[-2], X.shape[-1]
     S = cov_pearson(X) * (T - 1) / T  # LW uses the MLE normalization
     mean = jnp.mean(X, axis=-2, keepdims=True)
@@ -80,6 +85,19 @@ def cov_ledoit_wolf(X: jax.Array) -> jax.Array:
               + T * jnp.sum(S * S, axis=(-2, -1))) / T**2
     b2 = jnp.minimum(b2_raw, d2)
     shrink = jnp.where(d2 > 0, b2 / jnp.maximum(d2, 1e-30), 0.0)
+    return shrink, mu, S
+
+
+def cov_ledoit_wolf(X: jax.Array) -> jax.Array:
+    """Ledoit-Wolf (2004) shrinkage toward scaled identity.
+
+    Optimal shrinkage intensity estimated from the data; this is the
+    estimator BASELINE.json config 3 asks for ("Ledoit-Wolf covariance",
+    which the reference approximates with a fixed ridge).
+    """
+    n = X.shape[-1]
+    shrink, mu, S = ledoit_wolf_params(X)
+    eye = jnp.eye(n, dtype=X.dtype)
     return (
         shrink[..., None, None] * mu * eye
         + (1.0 - shrink)[..., None, None] * S
@@ -138,3 +156,42 @@ class Covariance:
             out = self.estimate_array(jnp.asarray(X.to_numpy(dtype=np.float64)))
             return pd.DataFrame(np.asarray(out), index=cols, columns=cols)
         return self.estimate_array(jnp.asarray(X))
+
+    def factor(self, X):
+        """Low-rank form ``Sigma == F' F + diag(d)`` of the estimate, or
+        ``None`` when the method has no such structure.
+
+        Every shipped estimator is (shifted) Gram-structured —
+        ``pearson``/``linear_shrinkage``/``ledoit_wolf`` build on the
+        centered-returns Gram matrix, ``duv`` is the identity — so the
+        factor exists with r = T rows (0 for ``duv``). Consumers
+        (:class:`porqua_tpu.optimization.MeanVariance`) assemble P *from*
+        this form, which is PSD by construction: no eigenvalue-clip
+        repair can desynchronize the dense and factored views. Returns
+        numpy ``(F, d)`` with F of shape (r, n)."""
+        import pandas as pd
+
+        if isinstance(X, pd.DataFrame):
+            X = X.to_numpy(dtype=np.float64)
+        X = np.asarray(X, dtype=np.float64)
+        T, n = X.shape
+        method = self.spec["method"]
+        Xc = X - X.mean(axis=0, keepdims=True)
+        if method == "pearson":
+            return Xc / np.sqrt(T - 1), np.zeros(n)
+        if method == "duv":
+            return np.zeros((0, n)), np.ones(n)
+        if method == "linear_shrinkage":
+            lam = _sanitize_lambda(
+                self.spec.get("lambda_covmat_regularization"))
+            sig2 = np.sum(Xc * Xc, axis=0) / (T - 1)
+            return (Xc / np.sqrt(T - 1),
+                    np.full(n, lam * float(np.mean(sig2))))
+        if method == "ledoit_wolf":
+            shrink, mu, _ = ledoit_wolf_params(jnp.asarray(X))
+            shrink = float(np.asarray(shrink))
+            mu = float(np.asarray(mu).reshape(()))
+            # MLE normalization: S = Xc'Xc / T.
+            return (np.sqrt((1.0 - shrink) / T) * Xc,
+                    np.full(n, shrink * mu))
+        return None

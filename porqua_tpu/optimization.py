@@ -148,6 +148,17 @@ class Optimization(ABC):
         lb, ub = constraints.bounds()
 
         parts = lift._as_parts(np.asarray(P, float), np.asarray(q, float), C, l, u, lb, ub)
+        # Low-rank objective structure (P == 2 Pf' Pf + diag(Pdiag)),
+        # when the objective exposes it. The dimension-expanding lifts
+        # below rebuild `parts` from scratch, so a lifted problem
+        # naturally sheds the factor (it would no longer reproduce the
+        # expanded P); the native-L1 path keeps the problem — and the
+        # factor — intact.
+        if "Pf" in self.objective:
+            parts["Pf"] = to_numpy(self.objective["Pf"])
+            pd_ = self.objective.get("Pdiag")
+            if pd_ is not None:
+                parts["Pdiag"] = to_numpy(pd_)
 
         # L1 terms (reference optimization.py:125-142). The two turnover
         # rewrites are mutually exclusive: a zero/absent transaction cost
@@ -191,6 +202,7 @@ class Optimization(ABC):
             lb=parts["lb"], ub=parts["ub"], constant=parts["constant"],
             n_max=self.params.get("n_max"), m_max=self.params.get("m_max"),
             dtype=self.params.get("dtype"),
+            Pf=parts.get("Pf"), Pdiag=parts.get("Pdiag"),
         )
         if "l1_weight" in parts:
             n_pad = self.model.n
@@ -222,8 +234,12 @@ class Optimization(ABC):
         import jax.numpy as jnp
 
         qp = self.model_canonical()
+        # Drop any objective factor with the objective: the factored
+        # polish/linsolve paths would otherwise solve against the REAL
+        # Hessian the stale Pf still describes, not the probe's.
         probe = qp._replace(P=jnp.eye(qp.n, dtype=qp.P.dtype) * 1e-6,
-                            q=jnp.zeros(qp.n, dtype=qp.q.dtype))
+                            q=jnp.zeros(qp.n, dtype=qp.q.dtype),
+                            Pf=None, Pdiag=None)
         sol = solve_qp(probe, self.params.to_solver_params())
         return bool(sol.status == Status.SOLVED)
 
@@ -250,10 +266,25 @@ class MeanVariance(Optimization):
         self.params.setdefault("risk_aversion", 1)
 
     def set_objective(self, optimization_data: OptimizationData) -> None:
-        covmat = self.covariance.estimate(X=optimization_data["return_series"])
-        covmat = covmat * self.params["risk_aversion"] * 2
-        mu = self.mean_estimator.estimate(X=optimization_data["return_series"]) * (-1)
-        self.objective = Objective(q=to_numpy(mu), P=to_numpy(covmat))
+        X = optimization_data["return_series"]
+        ra = self.params["risk_aversion"]
+        mu = self.mean_estimator.estimate(X=X) * (-1)
+        fac = self.covariance.factor(X)
+        if fac is not None:
+            # Assemble P FROM the factor form Sigma == F'F + diag(d):
+            # P = 2 ra Sigma = 2 (sqrt(ra) F)'(sqrt(ra) F) + diag(2 ra d)
+            # — PSD by construction (no repair can desynchronize the
+            # dense and factored views), and the solver's capacitance
+            # paths get the structure.
+            F, dvec = fac
+            Pf = np.sqrt(float(ra)) * F
+            Pdiag = 2.0 * float(ra) * dvec
+            P = 2.0 * Pf.T @ Pf + np.diag(Pdiag)
+            self.objective = Objective(q=to_numpy(mu), P=P,
+                                       Pf=Pf, Pdiag=Pdiag)
+        else:
+            covmat = self.covariance.estimate(X=X) * ra * 2
+            self.objective = Objective(q=to_numpy(mu), P=to_numpy(covmat))
 
     def solve(self) -> bool:
         return super().solve()
@@ -296,10 +327,16 @@ class LeastSquares(Optimization):
         constant = float(np.asarray(to_numpy(y.T @ y)).item())
 
         l2_penalty = self.params.get("l2_penalty")
+        Pdiag = np.zeros(X.shape[1])
         if l2_penalty is not None and l2_penalty != 0:
             P = to_numpy(P) + 2 * l2_penalty * np.eye(X.shape[1])
+            Pdiag = np.full(X.shape[1], 2.0 * l2_penalty)
 
-        self.objective = Objective(P=to_numpy(P), q=q, constant=constant)
+        # Expose the Gram structure (P == 2 X'X + diag(2 l2)): the
+        # polish factors the (T+m)-dim capacitance instead of n x n,
+        # and the capacitance linear-solve mode needs it.
+        self.objective = Objective(P=to_numpy(P), q=q, constant=constant,
+                                   Pf=to_numpy(X), Pdiag=Pdiag)
 
     def solve(self) -> bool:
         return super().solve()
@@ -328,7 +365,11 @@ class WeightedLeastSquares(Optimization):
         P = 2 * (Xv.T @ Xw)
         q = -2 * (Xw.T @ yv)
         constant = float(yv @ (wt * yv))
-        self.objective = Objective(P=P, q=q, constant=constant)
+        # P == 2 (sqrt(wt) X)'(sqrt(wt) X): same factor form as plain
+        # least squares, with the observation weights inside the factor.
+        self.objective = Objective(P=P, q=q, constant=constant,
+                                   Pf=np.sqrt(wt)[:, None] * Xv,
+                                   Pdiag=np.zeros(Xv.shape[1]))
 
     def solve(self) -> bool:
         return super().solve()

@@ -34,6 +34,7 @@ A :class:`CanonicalQP` is a NamedTuple of arrays, hence a JAX pytree:
 
 from __future__ import annotations
 
+import warnings
 from typing import NamedTuple, Optional, Sequence
 
 import jax
@@ -99,10 +100,18 @@ class CanonicalQP(NamedTuple):
               constant: float = 0.0,
               n_max: Optional[int] = None,
               m_max: Optional[int] = None,
-              dtype=None) -> "CanonicalQP":
+              dtype=None,
+              Pf: Optional[np.ndarray] = None,
+              Pdiag: Optional[np.ndarray] = None) -> "CanonicalQP":
         """Assemble + pad a single problem from host-side numpy arrays.
 
-        ``dtype=None`` means float32 (the TPU default)."""
+        ``dtype=None`` means float32 (the TPU default). ``Pf``/``Pdiag``
+        optionally expose the objective's low-rank structure
+        ``P == 2 Pf' Pf + diag(Pdiag)`` (checked here), which the
+        active-set polish — and the capacitance linear-solve mode —
+        exploit to factor at the (r + m)-dim capacitance instead of
+        n x n. The factor's row count r must match across problems that
+        will be stacked (it is not padded)."""
         dtype = jnp.float32 if dtype is None else dtype
         P = np.asarray(P, dtype=np.float64)
         q = np.asarray(q, dtype=np.float64).reshape(-1)
@@ -138,12 +147,51 @@ class CanonicalQP(NamedTuple):
         var_mask = np.concatenate([np.ones(n), np.zeros(dn)])
         row_mask = np.concatenate([np.ones(m), np.zeros(dm)])
 
+        Pf_pad = Pd_pad = None
+        if Pf is not None:
+            Pf = np.asarray(Pf, dtype=np.float64).reshape(-1, n)
+            Pd = (np.zeros(n) if Pdiag is None
+                  else np.asarray(Pdiag, dtype=np.float64).reshape(-1))
+            # Consistency probe for P == 2 Pf' Pf + diag(Pdiag): one
+            # matvec against a fixed dense direction (O(r n) instead of
+            # rebuilding the O(r n^2) Gram the caller just assembled).
+            # Rounding-grade drift (e.g. P assembled from float32
+            # source data) quietly degrades to the dense path — the
+            # factor is a performance structure, not semantics; only a
+            # gross mismatch (wrong factor) is an error.
+            v = np.cos(np.arange(n, dtype=np.float64))
+            pv = P @ v
+            fv = 2.0 * (Pf.T @ (Pf @ v)) + Pd * v
+            dev = float(np.max(np.abs(pv - fv)))
+            scale = max(float(np.max(np.abs(pv))), 1e-30)
+            if dev > 1e-3 * scale:
+                raise ValueError(
+                    "Pf/Pdiag do not reproduce P (convention: "
+                    "P == 2 Pf' Pf + diag(Pdiag)); matvec deviation "
+                    f"{dev:.3e} vs scale {scale:.3e}")
+            if dev > 1e-7 * scale:
+                warnings.warn(
+                    f"objective factor reproduces P only to {dev/scale:.1e} "
+                    "relative (float32-source rounding?); using the dense "
+                    "path", stacklevel=2)
+            else:
+                Pf_pad = np.concatenate(
+                    [Pf, np.zeros((Pf.shape[0], dn))], axis=1)
+                # Padded variables carry P = I on the diagonal block:
+                # put it in the diagonal completion so the factor form
+                # stays exact.
+                Pd_pad = np.concatenate([Pd, np.ones(dn)])
+        elif Pdiag is not None:
+            raise ValueError("Pdiag without Pf has no meaning")
+
         as_dev = lambda a: jnp.asarray(a, dtype=dtype)
         return CanonicalQP(
             P=as_dev(P_pad), q=as_dev(q_pad), C=as_dev(C_pad),
             l=as_dev(l_pad), u=as_dev(u_pad), lb=as_dev(lb_pad), ub=as_dev(ub_pad),
             var_mask=as_dev(var_mask), row_mask=as_dev(row_mask),
             constant=jnp.asarray(constant, dtype=dtype),
+            Pf=None if Pf_pad is None else as_dev(Pf_pad),
+            Pdiag=None if Pd_pad is None else as_dev(Pd_pad),
         )
 
 

@@ -60,3 +60,32 @@ def test_stack():
 def test_stack_shape_mismatch():
     with pytest.raises(ValueError):
         stack_qps([_toy(), _toy(n_max=5, m_max=4)])
+
+
+def test_build_accepts_and_pads_objective_factor():
+    rng = np.random.default_rng(0)
+    T, n = 12, 5
+    X = rng.standard_normal((T, n))
+    P = 2 * X.T @ X + np.diag(np.full(n, 0.3))
+    qp = CanonicalQP.build(
+        P, np.zeros(n), C=np.ones((1, n)), l=np.ones(1), u=np.ones(1),
+        n_max=8, m_max=3, dtype=jnp.float64,
+        Pf=X, Pdiag=np.full(n, 0.3),
+    )
+    assert qp.Pf.shape == (T, 8)
+    # Padded variables carry P = I via the diagonal completion, so the
+    # factor identity holds on the PADDED problem too.
+    recon = 2 * np.asarray(qp.Pf).T @ np.asarray(qp.Pf) + np.diag(
+        np.asarray(qp.Pdiag))
+    np.testing.assert_allclose(recon, np.asarray(qp.P), atol=1e-12)
+
+
+def test_build_rejects_inconsistent_factor():
+    rng = np.random.default_rng(1)
+    n = 4
+    X = rng.standard_normal((6, n))
+    P = 2 * X.T @ X
+    with pytest.raises(ValueError, match="do not reproduce"):
+        CanonicalQP.build(P, np.zeros(n), Pf=X * 1.01)
+    with pytest.raises(ValueError, match="Pdiag without Pf"):
+        CanonicalQP.build(P, np.zeros(n), Pdiag=np.ones(n))

@@ -107,3 +107,24 @@ def test_covariance_auto_repair(rng):
     X = rng.standard_normal((4, 6)) * 0.01  # T < N: singular but PSD
     out = cov.estimate_array(jnp.asarray(X))
     assert bool(is_psd(out, tol=1e-10))
+
+
+def test_covariance_factor_reproduces_estimate():
+    """Sigma == F'F + diag(d) for every Gram-structured method — the
+    factor form MeanVariance assembles P from."""
+    rng = np.random.default_rng(3)
+    X = rng.standard_normal((60, 10)) * 0.01
+    for method, kwargs in [
+        ("pearson", {}),
+        ("duv", {}),
+        ("linear_shrinkage", {"lambda_covmat_regularization": 0.2}),
+        ("ledoit_wolf", {}),
+    ]:
+        cov = Covariance(method=method, **kwargs)
+        fac = cov.factor(X)
+        assert fac is not None, method
+        F, d = fac
+        sigma_fac = F.T @ F + np.diag(d)
+        sigma = np.asarray(cov.estimate_array(jnp.asarray(X)))
+        np.testing.assert_allclose(sigma_fac, sigma, atol=1e-10,
+                                   err_msg=method)

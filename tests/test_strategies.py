@@ -13,6 +13,7 @@ import jax.numpy as jnp
 
 from porqua_tpu import (
     LAD,
+    LeastSquares,
     MeanVariance,
     PercentilePortfolios,
     QEQW,
@@ -192,3 +193,53 @@ def test_optimization_parameter_explicit_falsy_values_survive():
     assert d["verbose"] is True
     assert "allow_suboptimal" not in d
     assert not d.get("allow_suboptimal")
+
+
+def test_strategy_objectives_expose_gram_factor(market):
+    """LeastSquares / WeightedLeastSquares / MeanVariance lower with the
+    objective factor attached (P == 2 Pf'Pf + diag(Pdiag), verified by
+    CanonicalQP.build), so the polish/capacitance paths see the
+    structure through the strategy API, not just the tracking fast
+    path. A lifted problem sheds the factor (it no longer reproduces
+    the expanded P)."""
+    X, y = market
+    for opt in (
+        constrained(LeastSquares(l2_penalty=0.1), X.columns),
+        constrained(WeightedLeastSquares(tau=60), X.columns),
+        constrained(MeanVariance(), X.columns),
+    ):
+        opt.set_objective(OptimizationData(
+            align=False, return_series=X, bm_series=y))
+        model = opt.model_canonical()
+        assert model.Pf is not None, type(opt).__name__
+        assert model.Pdiag is not None
+
+    # Turnover-lifted problems drop the factor.
+    lifted = constrained(
+        LeastSquares(transaction_cost=0.002,
+                     x0={c: 1.0 / len(X.columns) for c in X.columns}),
+        X.columns,
+    )
+    lifted.set_objective(OptimizationData(
+        align=False, return_series=X, bm_series=y))
+    assert lifted.model_canonical().Pf is None
+
+
+def test_is_feasible_ignores_objective_factor(market):
+    """The feasibility probe replaces the objective; a factored
+    objective (Pf) must be dropped with it, or the factored solver
+    paths would probe against the real Hessian."""
+    X, y = market
+    opt = constrained(LeastSquares(), X.columns)
+    opt.set_objective(OptimizationData(
+        align=False, return_series=X, bm_series=y))
+    assert opt.model_canonical().Pf is not None
+    assert opt.is_feasible() is True
+
+    infeasible = LeastSquares()
+    infeasible.constraints = Constraints(selection=list(X.columns))
+    infeasible.constraints.add_budget()               # sum w == 1 ...
+    infeasible.constraints.add_box("LongOnly", upper=0.05)  # ... max 0.4
+    infeasible.set_objective(OptimizationData(
+        align=False, return_series=X, bm_series=y))
+    assert infeasible.is_feasible() is False
