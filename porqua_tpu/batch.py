@@ -98,6 +98,12 @@ def build_problems(bs: BacktestService,
         )
         for p in parts_list
     ]
+    # build() itself may have degraded individual dates to the dense
+    # path (rounding-grade factor drift, see CanonicalQP.build); a
+    # mixed batch cannot stack (None is an empty pytree subtree), so
+    # the whole batch falls back to dense together.
+    if use_pf and any(qp.Pf is None for qp in qps):
+        qps = [qp._replace(Pf=None, Pdiag=None) for qp in qps]
     l1_weight = l1_center = None
     if any("l1_weight" in p for p in parts_list):
         def pad_n(v):

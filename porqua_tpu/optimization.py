@@ -16,6 +16,7 @@ uses the corrected lift (reference ``optimization.py:333,341``).
 
 from __future__ import annotations
 
+import warnings
 from abc import ABC, abstractmethod
 from typing import Optional
 
@@ -97,6 +98,14 @@ class Optimization(ABC):
     # ------------------------------------------------------------------
 
     def solve_jax(self) -> None:
+        name = self.params.get("solver_name", "jax_admm")
+        if name not in (None, "", "jax_admm", "default"):
+            # Reference parity: dispatch by solver name (the reference
+            # routes through qpsolvers' backend strings,
+            # ``optimization.py:45`` + ``qp_problems.py:211``). Every
+            # backend of the compare harness is addressable here.
+            self._solve_via_backend(name)
+            return
         qp = self.model_canonical()
         solver_params = self.params.to_solver_params()
 
@@ -112,16 +121,91 @@ class Optimization(ABC):
             l1_center=None if l1 is None else l1[1],
         )
         self.solution = sol
+        self._publish_results(np.asarray(sol.x), int(sol.status))
 
+    def _publish_results(self, x: np.ndarray, status_code: int,
+                         suboptimal_acceptable: bool = True) -> None:
+        """One copy of the results contract for every solve path:
+        status from the code (with the ``allow_suboptimal`` MAX_ITER
+        acceptance where the backend can vouch for MAX_ITER-ness),
+        weights = x's universe slice on success, Nones on failure."""
+        status = bool(status_code == Status.SOLVED)
+        if (not status and suboptimal_acceptable
+                and self.params.get("allow_suboptimal")):
+            status = bool(status_code == Status.MAX_ITER)
         universe = self.constraints.selection
-        status = bool(sol.status == Status.SOLVED)
-        if not status and self.params.get("allow_suboptimal"):
-            status = bool(sol.status == Status.MAX_ITER)
         weights = pd.Series(
-            np.asarray(sol.x[: len(universe)]) if status else [None] * len(universe),
+            x[: len(universe)] if status else [None] * len(universe),
             index=universe,
         )
         self.results = {"weights": weights.to_dict(), "status": status}
+
+    # Reference solver-name spellings -> compare-harness backend keys.
+    # The reference's set (cvxopt/osqp/quadprog/daqp/highs/qpalm,
+    # ``qp_problems.py:19-30``) maps onto qpsolvers-* rows, which exist
+    # only where the qpsolvers package is installed.
+    _SOLVER_ALIASES = {
+        "ipm": "ipm-f64",
+        "interior-point": "ipm-f64",
+        "native": "native-cpp-admm",
+        "cpp": "native-cpp-admm",
+        "scipy": "scipy-slsqp",
+        "slsqp": "scipy-slsqp",
+        "admm-f32": "device-admm-f32",
+        "admm-f64": "device-admm-f64",
+        **{s: f"qpsolvers-{s}" for s in
+           ("cvxopt", "osqp", "quadprog", "daqp", "highs", "qpalm",
+            "clarabel", "ecos", "scs", "piqp", "proxqp")},
+    }
+
+    def _solve_via_backend(self, name: str) -> None:
+        """Solve through a named compare-harness backend (f64 IPM, the
+        native C++ ADMM core, scipy, qpsolvers-* when installed).
+
+        These backends consume the *unpadded* canonical parts and return
+        (x, y, mu, found); they do not implement the native L1 prox or
+        warm starts — cost terms must use the lifted formulation (the
+        reference's own, ``qp_problems.py:120-157``), which
+        ``canonical_parts`` emits whenever ``l1_native`` is unset.
+        """
+        from types import SimpleNamespace
+
+        from porqua_tpu.compare import available_backends
+
+        parts = self.canonical_parts()
+        if "l1_weight" in parts:
+            raise ValueError(
+                f"solver_name={name!r} cannot solve the native-L1 prox "
+                "form; drop l1_native (the lifted formulation is "
+                "backend-agnostic) or use the default jax_admm solver")
+        key = self._SOLVER_ALIASES.get(name, name)
+        backends = available_backends()
+        if key not in backends:
+            raise ValueError(
+                f"solver {name!r} (backend key {key!r}) is not available "
+                f"in this environment; have {sorted(backends)}")
+        x, y, mu, found = backends[key](parts, self.params.to_solver_params())
+
+        if not found and self.params.get("allow_suboptimal"):
+            # The backend contract reports only found/not-found; unlike
+            # the device solver's status codes it cannot distinguish
+            # "hit max_iter near the optimum" from "infeasible", so the
+            # MAX_ITER acceptance cannot be applied safely here.
+            warnings.warn(
+                f"solver {name!r} reported failure; allow_suboptimal "
+                "cannot be honored through named backends (no MAX_ITER/"
+                "infeasible distinction) — use the default jax_admm "
+                "solver for suboptimal acceptance", stacklevel=3)
+        self.solution = SimpleNamespace(
+            x=x, y=y, mu=mu, found=bool(found),
+            status=Status.SOLVED if found else Status.MAX_ITER,
+            iters=-1, prim_res=np.nan, dual_res=np.nan,
+        )
+        self._publish_results(
+            np.asarray(x),
+            Status.SOLVED if found else Status.MAX_ITER,
+            suboptimal_acceptable=False,
+        )
 
     def canonical_parts(self) -> dict:
         """Assemble objective + constraints into *unpadded* canonical parts

@@ -243,3 +243,29 @@ def test_is_feasible_ignores_objective_factor(market):
     infeasible.set_objective(OptimizationData(
         align=False, return_series=X, bm_series=y))
     assert infeasible.is_feasible() is False
+
+
+def test_solver_name_dispatch(market):
+    """Reference parity: solver_name routes to a named backend (the
+    reference dispatches qpsolvers strings, optimization.py:45 +
+    qp_problems.py:211). The f64 IPM and the native C++ core must agree
+    with the default device solver; unknown names fail loudly."""
+    X, y = market
+
+    def solve_with(name):
+        opt = constrained(LeastSquares(solver_name=name), X.columns)
+        opt.set_objective(OptimizationData(
+            align=False, return_series=X, bm_series=y))
+        assert opt.solve(), name
+        return np.array(list(opt.results["weights"].values()))
+
+    w_default = solve_with("jax_admm")
+    for name in ("ipm", "native"):
+        w = solve_with(name)
+        np.testing.assert_allclose(w, w_default, atol=5e-5, err_msg=name)
+
+    opt = constrained(LeastSquares(solver_name="gurobi"), X.columns)
+    opt.set_objective(OptimizationData(
+        align=False, return_series=X, bm_series=y))
+    with pytest.raises(ValueError, match="not available"):
+        opt.solve()
