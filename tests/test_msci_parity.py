@@ -172,10 +172,12 @@ def test_quarterly_sweep_all_windows_solve():
 
 def test_serial_and_batched_engines_agree_on_2020():
     """Engine parity on real data through the COVID regime: the serial
-    warm-start-chained engine and the one-XLA-program batched engine
-    must produce the same weights on the 2020 quarterly backtest (the
-    drive that exposed the round-3 equality-row stall — back then the
-    two engines failed on *different* dates)."""
+    per-date engine and the one-XLA-program batched engine must produce
+    the same weights on the 2020 quarterly backtest (the drive that
+    exposed the round-3 equality-row stall — back then the two engines
+    failed on *different* dates). No x0 builder is configured, so both
+    engines solve each date cold; warm-start coupling is exercised by
+    the scan tests."""
     import pandas as pd
 
     from porqua_tpu.backtest import Backtest, BacktestService
