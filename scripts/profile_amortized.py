@@ -12,6 +12,14 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
+
+# Honor a JAX_PLATFORMS request despite the axon sitecustomize pinning
+# jax_platforms at the config level (which silently overrides the env
+# var and then hangs device init against a dead tunnel).
+_env_plat = os.environ.get("JAX_PLATFORMS")
+if _env_plat and "axon" not in _env_plat:
+    jax.config.update("jax_platforms", _env_plat)
+
 import jax.numpy as jnp
 
 import functools
@@ -64,6 +72,13 @@ def main():
                 "bki,bi->bk", Li, jnp.einsum("bki,bk->bi", Li, x)) + 1e-3,
             Li[:, 0])), Linv),
         ("full-chol solve x5", _polish_stage, K),
+        # Round-3 additions: the blocked triangular inverse (halved
+        # substitution depth) and the capacitance (Woodbury) pipeline
+        # staged as the bench candidate — factor build at k = T + 1 and
+        # the 35-iteration W-apply loop.
+        ("blocked trinv", _blocked_trinv_stage, L),
+        ("capacitance build", _capacitance_build_stage, Xs),
+        ("35 it W-apply", _woodbury_apply_stage, Xs),
     ]
     for name, fn, arg in stages:
         per, floor = amortized(fn, arg)
@@ -73,13 +88,56 @@ def main():
     # full tracking step, amortized the same way
     from porqua_tpu.qp.solve import SolverParams
     from porqua_tpu.tracking import tracking_step
+    # The round-3 bench config (bench.py): 1-pass polish, Ruiz x2.
     params = SolverParams(max_iter=2000, eps_abs=1e-3, eps_rel=1e-3,
-                          polish_passes=1)
+                          polish_passes=1, scaling_iters=2)
     per, floor = amortized(
         lambda X: jnp.sum(tracking_step(X, ys, params).tracking_error), Xs,
         k=4)
     print(f"{'full tracking_step':20s} {per*1e3:8.2f} ms  "
           f"(dispatch floor {floor*1e3:6.1f} ms)", flush=True)
+
+
+def _blocked_trinv_stage(L):
+    from porqua_tpu.qp.admm import blocked_triangular_inverse
+    return jnp.sum(jax.vmap(blocked_triangular_inverse)(L))
+
+
+def _capacitance_build_stage(Xs):
+    """S = I + V D^-1 V' (k = T+1 rows) + chol(S) + W build — the
+    per-segment fixed cost of the Woodbury candidate."""
+    from porqua_tpu.qp.admm import blocked_triangular_inverse
+
+    def one(X):
+        T, n = X.shape
+        V = jnp.concatenate(
+            [jnp.sqrt(2.0) * X, jnp.ones((1, n), X.dtype)], axis=0)
+        inv_d = jnp.full((n,), 1.0 / 0.1, X.dtype)
+        Vd = V * inv_d[None, :]
+        S = jnp.eye(T + 1, dtype=X.dtype) + Vd @ V.T
+        Linv = blocked_triangular_inverse(jnp.linalg.cholesky(S))
+        W = Linv @ Vd
+        return jnp.sum(W)
+
+    return jnp.sum(jax.vmap(one)(Xs))
+
+
+def _woodbury_apply_stage(Xs):
+    """35 iterations of the factored K^-1 apply (two skinny matvecs) —
+    the per-iteration cost of the Woodbury candidate."""
+    def one(X):
+        T, n = X.shape
+        W = jnp.concatenate(
+            [jnp.sqrt(2.0) * X, jnp.ones((1, n), X.dtype)], axis=0)
+        inv_d = jnp.full((n,), 1.0 / 0.1, X.dtype)
+
+        def body(i, x):
+            t = W @ x
+            return 0.99 * (x * inv_d - t @ W) + 1e-3
+
+        return jnp.sum(jax.lax.fori_loop(0, 35, body, X[0]))
+
+    return jnp.sum(jax.vmap(one)(Xs))
 
 
 def _polish_stage(K):
