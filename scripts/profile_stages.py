@@ -18,6 +18,14 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
+
+# Honor a JAX_PLATFORMS request despite the axon sitecustomize pinning
+# jax_platforms at the config level (which silently overrides the env
+# var and then hangs device init against a dead tunnel).
+import os as _os
+_env_plat = _os.environ.get("JAX_PLATFORMS")
+if _env_plat and "axon" not in _env_plat:
+    jax.config.update("jax_platforms", _env_plat)
 import jax.numpy as jnp
 
 from porqua_tpu.profiling import measure_device
