@@ -147,11 +147,14 @@ def main():
     # in round 2 (the tunnel moves data at MB/s); the rest fit in
     # CHILD_TIMEOUT. n_results = RESULT lines a complete run prints
     # (the xover child measures both backends).
+    # Ordered cheapest-and-most-decisive first: if the tunnel returns
+    # only briefly (it flaps), the headline + candidate configs and the
+    # kernel-crossover verdicts land before the long B=1008 run.
     jobs = [
         (NORTHSTAR, [252], CHILD_TIMEOUT, 3),
-        (NORTHSTAR, [1008], max(CHILD_TIMEOUT, 1500), 1),
         (PALLAS_XOVER, [1000, 16], CHILD_TIMEOUT, 2),
         (PALLAS_XOVER, [2000, 8], CHILD_TIMEOUT, 2),
+        (NORTHSTAR, [1008], max(CHILD_TIMEOUT, 1500), 1),
     ]
     done = [False] * len(jobs)
     attempts = [0] * len(jobs)
