@@ -109,21 +109,26 @@ Xs_np, ys_np = synthetic_universe_np(seed=7, n_dates=B, window=252,
 Xs, ys = jnp.asarray(Xs_np), jnp.asarray(ys_np)
 qps = jax.jit(jax.vmap(build_tracking_qp))(Xs, ys)
 jax.block_until_ready(qps.P)
-for backend in ("xla", "pallas"):
+# xla-trinv is the incumbent; pallas-trinv the round-2 variant; the
+# pallas explicit-inverse form (one VMEM-resident matvec/iteration) was
+# rejected in round 2 for a conditioning blowup the eq-scale fix
+# removed — re-time it in its best-case regime.
+for backend, linsolve in (("xla", "trinv"), ("pallas", "trinv"),
+                          ("pallas", "inverse")):
     params = SolverParams(max_iter=2000, eps_abs=1e-3, eps_rel=1e-3,
                           polish=False, scaling_iters=2, backend=backend,
-                          linsolve="trinv", vmem_limit_mb=64.0)
+                          linsolve=linsolve, vmem_limit_mb=64.0)
     try:
         out = jax.jit(lambda q: solve_qp_batch(q, params))(qps)
         solved = int(jnp.sum(out.status == 1))
         per = measure_steady_state(
             lambda q: jnp.sum(solve_qp_batch(q, params).x), qps, k=3)
-        print(f"RESULT pallas-xover n={n} B={B} {backend}: {per*1e3:.1f} ms, "
-              f"solved {solved}/{B}, "
+        print(f"RESULT pallas-xover n={n} B={B} {backend}-{linsolve}: "
+              f"{per*1e3:.1f} ms, solved {solved}/{B}, "
               f"iters {float(jnp.median(out.iters)):.0f}", flush=True)
     except Exception as e:
-        print(f"RESULT pallas-xover n={n} B={B} {backend}: FAILED "
-              f"{type(e).__name__}: {e}", flush=True)
+        print(f"RESULT pallas-xover n={n} B={B} {backend}-{linsolve}: "
+              f"FAILED {type(e).__name__}: {e}", flush=True)
 '''
 
 
