@@ -157,8 +157,8 @@ def main():
     # kernel-crossover verdicts land before the long B=1008 run.
     jobs = [
         (NORTHSTAR, [252], CHILD_TIMEOUT, 3),
-        (PALLAS_XOVER, [1000, 16], CHILD_TIMEOUT, 2),
-        (PALLAS_XOVER, [2000, 8], CHILD_TIMEOUT, 2),
+        (PALLAS_XOVER, [1000, 16], CHILD_TIMEOUT, 3),
+        (PALLAS_XOVER, [2000, 8], CHILD_TIMEOUT, 3),
         (NORTHSTAR, [1008], max(CHILD_TIMEOUT, 1500), 1),
     ]
     done = [False] * len(jobs)
