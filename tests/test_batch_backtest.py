@@ -273,3 +273,28 @@ def test_scan_l1_rejects_varying_universe(rng):
             stack_qps(qps), n_assets=n, w_init=np.zeros(n),
             transaction_cost=0.01, universes=None,
         )
+
+
+def test_serial_engine_with_named_backend(market, rng):
+    """solver_name dispatch integrates with the full serial engine:
+    the native C++ core drives a small backtest end-to-end and agrees
+    with the default device solver's weights."""
+    from porqua_tpu.optimization import LeastSquares
+
+    rebdates = [str(d.date()) for d in
+                pd.bdate_range("2021-01-04", periods=3, freq="21B")]
+
+    def run(solver_name=None):
+        kwargs = {} if solver_name is None else {"solver_name": solver_name}
+        bs = make_service(market, rebdates, LeastSquares(**kwargs))
+        bt = Backtest()
+        bt.run(bs)
+        return bt.strategy.get_weights_df()
+
+    W_dev = run()
+    W_native = run("native")
+    assert list(W_native.index) == rebdates
+    np.testing.assert_allclose(
+        W_native.sum(axis=1).to_numpy(), 1.0, atol=1e-6)
+    np.testing.assert_allclose(
+        W_native.to_numpy(), W_dev.to_numpy(), atol=5e-5)
