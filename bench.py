@@ -418,6 +418,10 @@ def device_child(platform: str, n_dates: int) -> None:
             _secondary_config5(params, child_left)
         else:
             log(f"skipping config 5 ({child_left():.0f}s left)")
+        if child_left() > 90:
+            _secondary_config2(params, child_left, Xs, n_dates)
+        else:
+            log(f"skipping config 2 ({child_left():.0f}s left)")
     except Exception as e:  # pragma: no cover - best-effort extras
         log(f"secondary metrics aborted: {type(e).__name__}: {e}")
 
@@ -478,6 +482,58 @@ def _secondary_config4(params, child_left, Xs_np, ys_np, n_dates=64,
     })
     log(f"config 4: {sec:.3f}s for {n_dates} chained dates, "
         f"solved {solved}/{n_dates}, median TE {te:.3e}")
+
+
+def _secondary_config2(params, child_left, Xs, n_avail, n_dates=64):
+    """Config 2: min-variance long-only batch — shrinkage covariance
+    assembled on device from the return windows, solved in the same
+    program. Reuses the headline data (already on device)."""
+    import jax
+    import jax.numpy as jnp
+
+    from porqua_tpu.profiling import measure_device
+    from porqua_tpu.qp.canonical import CanonicalQP
+    from porqua_tpu.qp.solve import solve_qp_batch
+
+    n_dates = min(n_dates, n_avail)
+    log(f"config 2 (min-variance batch, {n_dates} dates)...")
+    Xb_base = Xs[:n_dates]
+
+    @jax.jit
+    def run(Xb):
+        def one(Xw):
+            n_ = Xw.shape[1]
+            S = jnp.cov(Xw, rowvar=False)
+            mu_t = jnp.trace(S) / n_
+            Sig = 0.9 * S + 0.1 * mu_t * jnp.eye(n_, dtype=Xw.dtype)
+            return CanonicalQP(
+                P=2.0 * Sig, q=jnp.zeros(n_, Xw.dtype),
+                C=jnp.ones((1, n_), Xw.dtype), l=jnp.ones(1, Xw.dtype),
+                u=jnp.ones(1, Xw.dtype), lb=jnp.zeros(n_, Xw.dtype),
+                ub=jnp.ones(n_, Xw.dtype),
+                var_mask=jnp.ones(n_, Xw.dtype),
+                row_mask=jnp.ones(1, Xw.dtype),
+                constant=jnp.zeros((), Xw.dtype),
+            )
+        qps = jax.vmap(one)(Xb)
+        return solve_qp_batch(qps, params)
+
+    sol = run(Xb_base)
+    jax.block_until_ready(sol.x)
+    sec, _, sol = measure_device(run, Xb_base,
+                                 n_runs=3 if child_left() > 60 else 1)
+    solved = int(np.sum(np.asarray(sol.status) == 1))
+    _emit({
+        "part": "config2_minvar",
+        "n_dates": n_dates,
+        "seconds": sec,
+        "seconds_per_solve": sec / n_dates,
+        "solved": solved,
+        "note": "shrinkage covariance assembled on device inside the "
+                "same program; CPU baseline in BASELINE.md config 2",
+    })
+    log(f"config 2: {sec:.3f}s for {n_dates} min-variance solves, "
+        f"solved {solved}/{n_dates}")
 
 
 def _secondary_config5(params, child_left, n_bench=24, n_dates=63,
