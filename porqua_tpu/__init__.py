@@ -45,6 +45,14 @@ from porqua_tpu.selection import Selection
 from porqua_tpu.builders import SelectionItemBuilder, OptimizationItemBuilder
 from porqua_tpu.portfolio import Portfolio, Strategy, floating_weights
 from porqua_tpu.backtest import Backtest, BacktestData, BacktestService
+from porqua_tpu.batch import (
+    FIXED_UNIVERSE,
+    build_problems,
+    run_batch,
+    solve_scan_l1,
+    solve_scan_l1_grid,
+    solve_scan_turnover,
+)
 from porqua_tpu.compare import compare_solvers, available_backends
 
 __all__ = [
@@ -77,6 +85,12 @@ __all__ = [
     "Backtest",
     "BacktestData",
     "BacktestService",
+    "FIXED_UNIVERSE",
+    "build_problems",
+    "run_batch",
+    "solve_scan_l1",
+    "solve_scan_l1_grid",
+    "solve_scan_turnover",
     "compare_solvers",
     "available_backends",
 ]
