@@ -277,6 +277,7 @@ def admm_flop_model(n: int, m: int, window: int, iters: float,
                     polish_refine_steps: int = 3,
                     l1_kkt_solves: int = 1,
                     linsolve: str = "trinv",
+                    woodbury_refine: int = 0,
                     polish_k: Optional[int] = None) -> Dict[str, float]:
     """Analytic FLOP + HBM-byte count for one batched tracking solve.
 
@@ -295,23 +296,40 @@ def admm_flop_model(n: int, m: int, window: int, iters: float,
     flops = {}
     flops["gram"] = 2.0 * T * n * n + 4.0 * T * n
     flops["ruiz"] = scaling_iters * 4.0 * (m * n + n * n)
-    fact = (n ** 3) / 3.0 + 2.0 * m * n * n  # cholesky + C'rhoC assembly
-    if pallas:
-        # Explicit inverse via n-rhs cho_solve plus the one-step Newton
-        # refinement (two further n^3 HIGHEST matmuls, admm.py
-        # refined_inverse).
-        fact += 2.0 * (n ** 3) + 4.0 * (n ** 3)
-    elif linsolve == "trinv":
-        fact += (n ** 3)  # explicit triangular-factor inverse (n-RHS trsm)
-    elif linsolve == "inverse":
-        fact += 2.0 * (n ** 3) + 4.0 * (n ** 3)
+    kcap = T + m  # capacitance dimension of the woodbury segment path
+    if linsolve == "woodbury" and not pallas:
+        # Capacitance factorization instead of the n x n KKT: S = I +
+        # (V D^-1) V' assembly (2 k^2 n), chol(S) + its triangular
+        # inverse (k^3/3 + k^3), and the W = L^-1 V D^-1 build (2 k^2 n).
+        fact = 4.0 * kcap * kcap * n + (kcap ** 3) / 3.0 + (kcap ** 3)
+    else:
+        fact = (n ** 3) / 3.0 + 2.0 * m * n * n  # chol + C'rhoC assembly
+        if pallas:
+            if linsolve == "trinv":
+                fact += (n ** 3)
+            else:
+                # Explicit inverse via n-rhs cho_solve plus the one-step
+                # Newton refinement (two further n^3 HIGHEST matmuls,
+                # admm.py refined_inverse).
+                fact += 2.0 * (n ** 3) + 4.0 * (n ** 3)
+        elif linsolve == "trinv":
+            fact += (n ** 3)  # explicit triangular-factor inverse
+        elif linsolve == "inverse":
+            fact += 2.0 * (n ** 3) + 4.0 * (n ** 3)
     flops["factorize"] = segs * fact
     # Linear-solve FLOPs per iteration: the chol trsm pair touches only
     # the triangular halves (2n^2 total), trinv applies two dense n x n
     # matvecs (4n^2 — the padded upper halves are multiplied-by-zero
-    # work the MXU still performs), inverse is one dense matvec (2n^2).
-    solve_flops = {"chol": 2.0, "trinv": 4.0, "inverse": 2.0}.get(
-        linsolve, 2.0) * n * n
+    # work the MXU still performs), inverse is one dense matvec (2n^2),
+    # woodbury two skinny (k x n) matvecs (+ refinement pairs).
+    solve_flops = {
+        "chol": 2.0 * n * n,
+        "trinv": 4.0 * n * n,
+        "inverse": 2.0 * n * n,
+        # base apply = two (k x n) matvecs; each refinement round adds
+        # an apply_K (factor form) + another base apply (~8 k n).
+        "woodbury": 4.0 * kcap * n * (1.0 + 2.0 * woodbury_refine),
+    }.get(linsolve, 2.0 * n * n)
     per_iter = solve_flops + 4.0 * m * n + 15.0 * n
     flops["iterate"] = iters * per_iter
     flops["residual_checks"] = segs * (2.0 * n * n + 4.0 * m * n)
@@ -340,10 +358,16 @@ def admm_flop_model(n: int, m: int, window: int, iters: float,
     bytes_["gram"] = item * (T * n + n * n)
     # Factor/Kinv traffic: the XLA path re-reads the factor (n^2) twice
     # per iteration (two triangular solves); the Pallas path reads the
-    # inverse once per segment (VMEM-resident across the segment).
+    # inverse once per segment (VMEM-resident across the segment); the
+    # woodbury path re-reads the skinny W (k x n) per apply.
     if pallas:
         bytes_["iterate"] = segs * item * (n * n + m * n)
         bytes_["factorize"] = segs * item * 6.0 * n * n
+    elif linsolve == "woodbury":
+        bytes_["iterate"] = iters * item * (
+            2.0 * kcap * n * (1.0 + 2.0 * woodbury_refine) + 2 * m * n)
+        bytes_["factorize"] = segs * item * (4.0 * kcap * n
+                                             + 3.0 * kcap * kcap)
     else:
         bytes_["iterate"] = iters * item * 2.0 * (n * n) + iters * item * 2 * m * n
         bytes_["factorize"] = segs * item * 4.0 * n * n
