@@ -301,19 +301,23 @@ def device_child(platform: str, n_dates: int) -> None:
     jax.block_until_ready((Xs, ys))
 
     # f32 on device: run ADMM to a loose in-loop tolerance (the f32
-    # residual floor is ~1e-3) and let one active-set polish pass land
-    # accuracy. Round 3 re-tested dropping the polish entirely (the
-    # equality-row limit cycle that made loose-eps iterates fragile is
-    # gone — see BASELINE.md): an 8-date sample showed TE parity, but
-    # the 32-date fallback run exposed a +2% median-TE drift without
-    # polish (6.27e-4 vs the f64 baseline's 6.14e-4) — some dates'
-    # loose-eps f32 iterates do still need the finish. Matched TE is
-    # the acceptance bar, so the ~20 ms polish stays. scaling_iters=2:
-    # Ruiz converges on these Gram-matrix problems in a couple of
-    # sweeps (TE parity measured at 4, 2, and 1 sweeps; each extra
-    # sweep rereads the 252 MB P batch).
+    # residual floor is ~1e-3). Round 3, measured against the f64 CPU
+    # baseline ON THE SAME dates (an earlier comparison paired problems
+    # from different RNG stream positions and mis-attributed a "+2% TE
+    # drift" to the missing polish): with the equality-row step-size
+    # weighting removed from the defaults (rho_eq_scale 1.0, see
+    # BASELINE.md), the loose-eps iterate's tracking error is matched
+    # to 0.01% WITHOUT the polish (device 6.2678e-4 vs f64 baseline
+    # 6.2670e-4 median over dates 0..31; maxima match too), so the
+    # ~20 ms/pass polish stage is off here. Callers needing exact
+    # constraint satisfaction get it from the library default (the
+    # polish is a real active-set iteration as of round 3 — see
+    # qp/polish.py:polish_iterate — landing |sum w - 1| ~ 4e-7 in two
+    # passes). scaling_iters=2: Ruiz converges on these Gram-matrix
+    # problems in a couple of sweeps (TE parity measured at 4, 2, and
+    # 1 sweeps; each extra sweep rereads the 252 MB P batch).
     params = SolverParams(max_iter=2000, eps_abs=1e-3, eps_rel=1e-3,
-                          polish_passes=1, scaling_iters=2)
+                          polish=False, scaling_iters=2)
 
     t0 = time.perf_counter()
     out = tracking_step_jit(Xs, ys, params)
@@ -741,6 +745,13 @@ def _assemble(state) -> dict:
                 if len(base["per_date"]) >= n_dates_dev
                 else base["seconds"] * n_dates_dev / base["n_measured"])
             payload["vs_baseline"] = round(base_slice / result["seconds"], 2)
+            if reduced and len(base["tes"]) >= n_dates_dev:
+                # The top-level baseline_median_te is the median over
+                # ALL dates; tracking errors only compare over the SAME
+                # date set (medians over different slices differ by ~2%
+                # on this data — a date-set artifact, not solver error).
+                payload["baseline_median_te_same_dates"] = float(
+                    np.median(base["tes"][:n_dates_dev]))
         else:
             payload["vs_baseline"] = 0.0
         steady = result.get("seconds_steady_state") or 0.0

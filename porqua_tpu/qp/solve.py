@@ -26,7 +26,7 @@ from porqua_tpu.qp.admm import (
     _support,
 )
 from porqua_tpu.qp.canonical import CanonicalQP
-from porqua_tpu.qp.polish import polish as _polish
+from porqua_tpu.qp.polish import polish_iterate as _polish_iterate
 from porqua_tpu.qp.ruiz import Scaling, equilibrate
 
 
@@ -72,15 +72,18 @@ def _solve_impl(qp: CanonicalQP,
                        l1_weight=l1w_s, l1_center=l1c_s)
     x, z, w, y, mu = state.x, state.z, state.w, state.y, state.mu
 
-    # LU polish on the active set. With a live L1 term the polish is
-    # prox-aware (see qp.polish): kink variables are pinned, the fixed
-    # subgradient shifts q, and the smooth KKT system is solved — so
-    # cost-aware dates get the same high-accuracy finish as plain ones.
+    # Active-set polish. With a live L1 term the polish is prox-aware
+    # (see qp.polish): kink variables are pinned, the fixed subgradient
+    # shifts q, and the smooth KKT system is solved — so cost-aware
+    # dates get the same high-accuracy finish as plain ones. The passes
+    # form a true active-set iteration (each pass re-classifies from
+    # the previous CANDIDATE, not from the possibly-unchanged pick —
+    # see polish_iterate for why the old loop could fix-point on a
+    # rejected first pass).
     if params.polish:
-        for _ in range(params.polish_passes):
-            x, z, w, y, mu = _polish(
-                scaled, scaling, params, x, z, w, y, mu,
-                l1_weight=l1w_s, l1_center=l1c_s)
+        x, z, w, y, mu = _polish_iterate(
+            scaled, scaling, params, x, z, w, y, mu,
+            l1_weight=l1w_s, l1_center=l1c_s)
 
     r_prim, r_dual, eps_p, eps_d, _, _ = _residuals(
         scaled, scaling, x, z, w, y, mu, params

@@ -163,3 +163,40 @@ def test_mesh_padding_keeps_factor_structure():
         np.asarray(padded.P[-1]), rtol=0, atol=0)
     sol = solve_qp_batch(padded, _params("woodbury", jnp.float64))
     assert np.all(np.asarray(sol.status) == 1)
+
+
+def test_polish_iteration_recovers_from_rejected_first_pass():
+    """Regression pin for the round-3 active-set-iteration fix: from a
+    loose (eps 1e-3) f32 iterate on the north-star tracking problem the
+    FIRST polish candidate is rejected (borderline unpinned variables
+    dip out of bounds, raising the primal residual), and the old
+    pass loop fix-pointed on that rejection. Threading the candidate
+    forward must land near-exact constraint satisfaction by pass 2."""
+    import jax
+
+    from porqua_tpu.qp.admm import admm_solve, _residuals
+    from porqua_tpu.qp.polish import polish_iterate
+    from porqua_tpu.qp.ruiz import equilibrate
+    from porqua_tpu.tracking import build_tracking_qp, synthetic_universe_np
+
+    Xs, ys = synthetic_universe_np(seed=42, n_dates=1, window=252,
+                                   n_assets=500)
+    qp = build_tracking_qp(jnp.asarray(Xs[0], jnp.float32),
+                           jnp.asarray(ys[0], jnp.float32))
+    params = SolverParams(max_iter=2000, eps_abs=1e-3, eps_rel=1e-3,
+                          scaling_iters=2)
+    scaled, scaling = equilibrate(qp, iters=2)
+    st = admm_solve(scaled, scaling, params)
+    it5 = (st.x, st.z, st.w, st.y, st.mu)
+
+    # One pass alone is rejected on this iterate (the setup the fix
+    # addresses): the point comes back unchanged.
+    one = polish_iterate(scaled, scaling, params, *it5, passes=1)
+    assert bool(jnp.all(one[0] == st.x)), "expected first pass rejected"
+
+    # Two threaded passes recover: budget exact to f32 roundoff.
+    two = polish_iterate(scaled, scaling, params, *it5, passes=2)
+    x_u = scaling.D * two[0]
+    assert abs(float(jnp.sum(x_u)) - 1.0) < 1e-5
+    rp, rd, *_ = _residuals(scaled, scaling, *two, params)
+    assert float(rp) < 1e-5
