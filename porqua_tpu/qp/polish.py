@@ -188,9 +188,17 @@ def polish_iterate(qp: CanonicalQP,
         accept = finite & gates_ok & (cand_err < best_err)
         best = tuple(jnp.where(accept, c, b) for c, b in zip(cand, best))
         best_err = jnp.where(accept, cand_err, best_err)
-        # Thread the candidate as the next classification point whenever
-        # it is finite — even when not (yet) better.
-        guess = tuple(jnp.where(finite, c, g) for c, g in zip(cand, guess))
+        # Thread the candidate as the next classification point even
+        # when not (yet) better — but only when it passes the sanity
+        # gates: an L1 candidate that failed them is PROVABLY
+        # misclassified (a kink/sign pattern the KKT residuals cannot
+        # vouch for, since mu absorbs any subgradient), and classifying
+        # from it can produce a kink-degenerate point whose residuals
+        # look clean while the chain silently freezes at its center.
+        # Without an L1 term gates_ok is constant True and threading is
+        # unconditional (modulo finiteness).
+        thread = finite & gates_ok
+        guess = tuple(jnp.where(thread, c, g) for c, g in zip(cand, guess))
     return best
 
 
