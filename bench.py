@@ -50,6 +50,7 @@ device-seconds (speedup, higher is better). Exit code is always 0.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import signal
@@ -228,6 +229,18 @@ def _bench_polish_k(Xs, ys):
     return polish_capacitance_dim(qp_shape)
 
 
+def _resolved_linsolve(params, Xs, ys) -> str:
+    """The linear-solve mode the ADMM segments will actually run, from
+    the solver's own dispatch rule (shape-only — no device work)."""
+    import jax
+
+    from porqua_tpu.qp.admm import resolve_linsolve
+    from porqua_tpu.tracking import build_tracking_qp
+
+    qp_shape = jax.eval_shape(build_tracking_qp, Xs[0], ys[0])
+    return resolve_linsolve(params, qp_shape)
+
+
 def probe_child(platform: str) -> None:
     """Minimal liveness check: init the backend, run one tiny dispatch,
     print a marker line. Bounded by the parent's probe timeout — a hung
@@ -295,6 +308,10 @@ def device_child(platform: str, n_dates: int) -> None:
     # and the per-date-slice comparison in _assemble would pair
     # unrelated instances.
     Xs_np, ys_np = make_data_np()
+    # Clamp to the dates that exist: a fallback invocation can ask for
+    # FALLBACK_DATES > PORQUA_BENCH_DATES (tiny verify shapes), and
+    # reporting the requested count would inflate every per-date number.
+    n_dates = min(n_dates, Xs_np.shape[0])
     Xs_np, ys_np = Xs_np[:n_dates], ys_np[:n_dates]
     Xs = jnp.asarray(Xs_np)
     ys = jnp.asarray(ys_np)
@@ -316,8 +333,24 @@ def device_child(platform: str, n_dates: int) -> None:
     # passes). scaling_iters=2: Ruiz converges on these Gram-matrix
     # problems in a couple of sweeps (TE parity measured at 4, 2, and
     # 1 sweeps; each extra sweep rereads the 252 MB P batch).
-    params = SolverParams(max_iter=2000, eps_abs=1e-3, eps_rel=1e-3,
-                          polish=False, scaling_iters=2)
+    base_params = SolverParams(max_iter=2000, eps_abs=1e-3, eps_rel=1e-3,
+                               polish=False, scaling_iters=2)
+    params = base_params
+    if dev.platform == "tpu":
+        # Capacitance (Woodbury) segment factorization, promoted to the
+        # TPU headline config after the round-3 on-chip batch
+        # (scripts/tpu_session_measure.py): 35.0 ms steady-state vs
+        # trinv's 62.6 ms at B=252, 252/252 solved in one 35-iteration
+        # segment, TE 6.1402e-4 vs the f64 baseline's 6.139e-4 — the
+        # chol(T+m=253) capacitance factorization replaces chol(500) +
+        # its triangular inverse, and the per-iteration operator is two
+        # skinny (k x n) matvecs instead of one dense n x n. refine=0
+        # is sound here because rho_eq_scale is 1.0 (round 2 measured
+        # this mode poisoned at eq_scale 1e3). The CPU fallback keeps
+        # linsolve="auto" (-> trinv at f32): XLA-CPU timings of the
+        # capacitance path were not re-validated at the fallback size.
+        params = dataclasses.replace(base_params, linsolve="woodbury",
+                                     woodbury_refine=0, check_interval=35)
 
     t0 = time.perf_counter()
     out = tracking_step_jit(Xs, ys, params)
@@ -356,6 +389,7 @@ def device_child(platform: str, n_dates: int) -> None:
     solved = int(np.sum(np.asarray(out.status) == 1))
     te_dev = float(np.median(np.asarray(out.tracking_error)))
     iters_med = float(np.median(np.asarray(out.iters)))
+    linsolve_ran = _resolved_linsolve(params, Xs, ys)
     log(f"device runs: {['%.3f' % r for r in runs]}s; "
         f"solved {solved}/{n_dates}; median TE {te_dev:.3e}; "
         f"median iters {iters_med:.0f}")
@@ -370,10 +404,11 @@ def device_child(platform: str, n_dates: int) -> None:
         scaling_iters=params.scaling_iters,
         pallas=False,
         polish_passes=params.polish_passes if params.polish else 0,
-        # This benchmark's data is f32, and linsolve="auto" resolves f32
-        # to trinv on EVERY backend (the f32 cho_solve substitution
-        # stalls at this scale — resolve_linsolve) — count that.
-        linsolve="trinv",
+        # Count what actually ran — ask the solver's own dispatch rule
+        # rather than re-encoding it here (the TPU headline opts into
+        # the capacitance path; "auto" resolves per dtype/backend).
+        linsolve=linsolve_ran,
+        woodbury_refine=params.woodbury_refine,
         # The tracking QP carries its factor (P = 2 X'X), so the polish
         # runs the exact-pinning capacitance path when it pays; ask the
         # gate itself so the model counts exactly what ran.
@@ -402,6 +437,12 @@ def device_child(platform: str, n_dates: int) -> None:
         "solved": solved,
         "median_te": te_dev,
         "median_iters": iters_med,
+        # The solver config is platform-conditional (TPU runs the
+        # capacitance path), so the payload must say what produced it —
+        # a cross-round diff can't otherwise tell an algorithm change
+        # from a hardware change.
+        "linsolve": linsolve_ran,
+        "check_interval": params.check_interval,
         "roofline": {k: v for k, v in roofline.items()
                      if not isinstance(v, dict)},
     })
@@ -413,17 +454,22 @@ def device_child(platform: str, n_dates: int) -> None:
     # Each needs a fresh compile (~20-40 s) + a few dispatches; only
     # attempt with comfortable headroom, and emit each the moment it
     # finishes.
+    # The secondaries keep the general-purpose trinv config: the
+    # capacitance promotion above was measured on the headline tracking
+    # batch specifically, and the L1-scan / grid / min-variance paths
+    # were not part of that on-chip validation.
+    params_sec = base_params
     try:
         if child_left() > 90:
-            _secondary_config4(params, child_left, Xs_np, ys_np)
+            _secondary_config4(params_sec, child_left, Xs_np, ys_np)
         else:
             log(f"skipping config 4 ({child_left():.0f}s left)")
         if child_left() > 90:
-            _secondary_config5(params, child_left)
+            _secondary_config5(params_sec, child_left)
         else:
             log(f"skipping config 5 ({child_left():.0f}s left)")
         if child_left() > 90:
-            _secondary_config2(params, child_left, Xs, n_dates)
+            _secondary_config2(params_sec, child_left, Xs, n_dates)
         else:
             log(f"skipping config 2 ({child_left():.0f}s left)")
     except Exception as e:  # pragma: no cover - best-effort extras
@@ -772,6 +818,11 @@ def _assemble(state) -> dict:
             "device_solved": result["solved"],
             "compile_seconds": round(result["compile_s"], 2),
         })
+        # Which solver config produced the number (platform-conditional
+        # since round 3: TPU runs the capacitance/woodbury segments).
+        for key in ("linsolve", "check_interval"):
+            if result.get(key) is not None:
+                payload[key] = result[key]
         if reduced:
             payload["fallback_reduced"] = True
             payload["fallback_dates"] = n_dates_dev

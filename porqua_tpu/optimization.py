@@ -569,6 +569,17 @@ class PercentilePortfolios(Optimization):
             return self.estimator.estimate(
                 X=optimization_data["return_series"])
         frame = optimization_data["scores"]
+        if isinstance(frame, pd.Series):
+            # A plain per-asset score vector needs no cross-column
+            # reduction; 'field' / 'score_weights' address columns of
+            # a frame, so silently honoring a Series instead would
+            # drop the caller's selection or blend.
+            if field is not None or self.params.get("score_weights"):
+                raise ValueError(
+                    "'field'/'score_weights' were given but the scores "
+                    "entry is a Series (one score per asset), not a "
+                    "frame")
+            return frame
         if field is not None:
             return frame[field]
         blend = self.params.get("score_weights")

@@ -96,19 +96,29 @@ class SolverParams:
     #             diag(Pdiag)) and raises ValueError without it: the
     #             segment factorizations run on the r x r capacitance
     #             matrix S = I + V D^-1 V' instead of the n x n KKT.
-    #             Measured NOT to pay on the north-star batch (see
-    #             resolve_linsolve) — the factored structure is instead
-    #             exploited by the polish, unconditionally, whenever
-    #             qp.Pf is present (qp.polish._kkt_solve_factored).
+    #             Round 2 measured this NOT to pay — but that regime
+    #             (refine>=1 forced by eq_scale 1e3's conditioning,
+    #             straggler lanes charging extra segments) died with
+    #             the x1000 equality weighting. At rho_eq_scale=1.0,
+    #             refine=0 converges at trinv-grade iteration counts,
+    #             and with check_interval=35 the round-3 on-chip batch
+    #             measured 35.0 ms vs trinv's 62.6 ms on the north-star
+    #             B=252 (252/252 solved, TE parity) — it is the TPU
+    #             headline config in bench.py. The factored structure
+    #             is also exploited by the polish, unconditionally,
+    #             whenever qp.Pf is present (_kkt_solve_factored).
     # "auto"    — "trinv" for f32 on every backend (the f32 cho_solve
     #             substitution stalls at production scale, see
     #             resolve_linsolve); f64: "trinv" on TPU, "chol"
     #             elsewhere.
     linsolve: str = "auto"
     # Inner iterative-refinement steps of the Woodbury apply (residual
-    # via the factor form, two extra matvec pairs each). 1 restores
-    # trinv-grade ADMM convergence on the north-star batch; the raw
-    # apply (0) stalls the worst-conditioned lanes just above eps.
+    # via the factor form, two extra matvec pairs each). The default 1
+    # is the safe setting for arbitrary rho_eq_scale; at the library
+    # default eq_scale 1.0 the raw apply (0) converges at trinv-grade
+    # iteration counts (the round-2 "stalls just above eps" finding was
+    # an artifact of the x1000 equality weighting's conditioning) and
+    # is what the bench's TPU headline config uses.
     woodbury_refine: int = 1
     # VMEM budget for the fused Pallas segment (Kinv + C + state vectors
     # must all be core-resident; ~16 MB/core on v5e, leave headroom).
@@ -292,14 +302,17 @@ def resolve_linsolve(params: SolverParams, qp: CanonicalQP) -> str:
     """
     ls = params.linsolve
     if ls == "woodbury":
-        # Explicit opt-in only. Measured on the north-star batch the
-        # capacitance-sized factorizations do NOT pay inside the ADMM
-        # loop: the apply's refinement triples per-iteration cost and
-        # the worst-conditioned lanes still need extra segments, which
-        # the batched while_loop charges to every lane (3.7 s vs 95 ms
-        # for trinv). The factored structure pays in the *polish*
-        # (exact pinning, no penalty amplification), which uses it
-        # automatically whenever qp.Pf is present — see qp.polish.
+        # Explicit opt-in only — because it needs qp.Pf and its payoff
+        # is regime-dependent. Round 2 (eq_scale 1e3) measured it NOT
+        # to pay (refinement tripled per-iteration cost, straggler
+        # lanes charged extra segments to the whole batch: 3.7 s vs
+        # 95 ms for trinv); at the round-3 default rho_eq_scale=1.0
+        # with refine=0 + check_interval=35 it *wins* on chip (35.0 ms
+        # vs trinv's 62.6 ms on the north-star B=252, TE parity) and
+        # is the bench's TPU headline config. The factored structure
+        # also pays in the *polish* (exact pinning, no penalty
+        # amplification), which uses it automatically whenever qp.Pf
+        # is present — see qp.polish.
         if qp.Pf is None:
             raise ValueError(
                 "linsolve='woodbury' requires the factored objective "

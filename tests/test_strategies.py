@@ -175,6 +175,25 @@ def test_percentile_results_carry_status_and_objective(rng):
     assert pp.results["objective"] > 0
 
 
+def test_percentile_accepts_series_scores(rng):
+    """A plain per-asset score vector (Series, not a one-column frame)
+    is a natural way to hand a ranking signal to PercentilePortfolios;
+    it must rank directly instead of crashing in the cross-column mean,
+    and 'field' against a Series is a caller error, not a label lookup."""
+    scores = pd.Series(rng.standard_normal(20), index=[f"S{i}" for i in range(20)])
+    pp = PercentilePortfolios(n_percentiles=5)
+    pp.constraints = Constraints(selection=list(scores.index))
+    pp.set_objective(OptimizationData(align=False, scores=scores))
+    assert pp.solve()
+    w = pd.Series(pp.results["weights"])
+    assert np.isclose(w[w > 0].sum(), 1.0) and np.isclose(w[w < 0].sum(), -1.0)
+
+    pp_bad = PercentilePortfolios(field="s", n_percentiles=5)
+    pp_bad.constraints = Constraints(selection=list(scores.index))
+    with pytest.raises(ValueError, match="Series"):
+        pp_bad.set_objective(OptimizationData(align=False, scores=scores))
+
+
 def test_optimization_parameter_explicit_falsy_values_survive():
     """Key-presence defaulting: explicitly passing a falsy value must not
     silently re-default (the reference's truthiness quirk)."""
