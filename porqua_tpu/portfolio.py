@@ -277,6 +277,17 @@ class Strategy:
         if vc != 0:
             traded = self.turnover(return_series=return_series,
                                    rescale=False) * vc
+            missing = [d for d in traded.index[1:] if d not in returns.index]
+            if missing:
+                # Same convention as the reference (costs are charged on
+                # the rebalance date's own return row), surfaced as a
+                # diagnosis instead of a pandas KeyError deep in .loc.
+                raise ValueError(
+                    "variable costs are charged on rebalance dates, but "
+                    f"{[str(d)[:10] for d in missing[:3]]}"
+                    f"{'...' if len(missing) > 3 else ''} are not in the "
+                    "return series — pick rebalance dates from the data's "
+                    "index (trading days)")
             # The first rebalance date has no return row; its cost hits
             # the first available return instead.
             returns.iloc[0] -= traded.iloc[0]

@@ -90,6 +90,22 @@ def test_simulate_variable_costs_turnover(rng):
     )
 
 
+def test_simulate_rejects_non_trading_rebalance_dates(rng):
+    """Variable costs are charged on the rebalance date's own return
+    row (the reference's convention); a rebalance date outside the
+    return-series index must produce a diagnosis naming the dates, not
+    a pandas KeyError from deep inside ``.loc``."""
+    returns = make_returns(rng)
+    strategy = Strategy([])
+    # Second date is a Saturday — not in the bdate_range index.
+    for d, w in zip(["2021-01-14", "2021-01-16", "2021-03-04"],
+                    [rng.dirichlet(np.ones(5)) for _ in range(3)]):
+        strategy.portfolios.append(
+            Portfolio(d, dict(zip(returns.columns, w))))
+    with pytest.raises(ValueError, match="2021-01-16"):
+        strategy.simulate(return_series=returns, fc=0, vc=0.002)
+
+
 def test_turnover_rescale_true_long_short(rng):
     """VERDICT item 7: the rescale=True drift (long/short renormalized,
     reference portfolio.py:283-286) must have a device equivalent —

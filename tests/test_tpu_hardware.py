@@ -197,6 +197,35 @@ def test_steady_state_timer_sane_on_hardware():
     assert floor >= 0.0
 
 
+def test_bench_woodbury_config_matches_trinv_on_hardware():
+    """The TPU headline config (capacitance/woodbury segments, refine 0,
+    check_interval 35 — promoted in round 3 after the on-chip batch
+    measured 35.0 ms vs trinv's 62.6 ms at B=252) must keep solving and
+    match the trinv path's tracking error on a north-star shard. Pins
+    the promotion against solver regressions: refine=0 is only sound at
+    rho_eq_scale 1.0 (the library default since round 3)."""
+    import dataclasses
+
+    from porqua_tpu.qp.solve import SolverParams as SP
+    from porqua_tpu.tracking import synthetic_universe_np, tracking_step_jit
+
+    Xs_np, ys_np = synthetic_universe_np(
+        seed=11, n_dates=16, window=252, n_assets=500)
+    Xs, ys = jnp.asarray(Xs_np), jnp.asarray(ys_np)
+    base = SP(eps_abs=1e-3, eps_rel=1e-3, max_iter=2000,
+              polish=False, scaling_iters=2)
+    wb = dataclasses.replace(base, linsolve="woodbury",
+                             woodbury_refine=0, check_interval=35)
+    out_t = tracking_step_jit(Xs, ys, base)
+    out_w = tracking_step_jit(Xs, ys, wb)
+    st_t, st_w = np.asarray(out_t.status), np.asarray(out_w.status)
+    assert int((st_w == Status.SOLVED).sum()) == 16, st_w
+    assert int((st_t == Status.SOLVED).sum()) == 16, st_t
+    te_t = np.asarray(out_t.tracking_error)
+    te_w = np.asarray(out_w.tracking_error)
+    np.testing.assert_allclose(te_w, te_t, rtol=2e-3)
+
+
 def test_northstar_shard_matched_tracking_error(rng):
     """A 16-date slice of the north-star shape (500 assets, window 252)
     solved on-chip: every date solves, and the f32+polish tracking error
