@@ -30,18 +30,47 @@ _lock = threading.Lock()
 _lib = None
 
 
+def _so_path() -> str:
+    """Cache location for the compiled library: next to the source when
+    the package directory is writable (editable installs, this repo),
+    else a per-user cache dir (wheels installed into a read-only or
+    root-owned site-packages must still work for unprivileged users).
+
+    The user-cache filename carries a hash of the source and the host
+    arch: wheel timestamps are unreliable (SOURCE_DATE_EPOCH), so an
+    mtime check alone would happily reuse a binary built from an older
+    release — or, on an NFS-shared home, one compiled with
+    ``-march=native`` for a different machine."""
+    if os.access(_DIR, os.W_OK):
+        return _SO
+    import hashlib
+    import platform
+
+    with open(_SRC, "rb") as fh:
+        key = hashlib.sha256(fh.read())
+    key.update(platform.machine().encode())
+    key.update(platform.processor().encode())
+    cache = os.path.join(
+        os.environ.get("XDG_CACHE_HOME",
+                       os.path.join(os.path.expanduser("~"), ".cache")),
+        "porqua_tpu")
+    os.makedirs(cache, exist_ok=True)
+    return os.path.join(cache, f"libporqua_qp-{key.hexdigest()[:16]}.so")
+
+
 def build_library(force: bool = False) -> str:
     """Compile qp_solver.cpp to a shared library (cached)."""
+    so = _so_path()
     with _lock:
-        if force or not os.path.exists(_SO) or (
-            os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+        if force or not os.path.exists(so) or (
+            os.path.getmtime(so) < os.path.getmtime(_SRC)
         ):
             cmd = [
                 "g++", "-O3", "-march=native", "-fPIC", "-shared",
-                "-std=c++17", _SRC, "-o", _SO,
+                "-std=c++17", _SRC, "-o", so,
             ]
             subprocess.run(cmd, check=True, capture_output=True)
-    return _SO
+    return so
 
 
 def _load():

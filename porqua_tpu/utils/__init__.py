@@ -1,5 +1,12 @@
 from porqua_tpu.utils.psd import is_psd, nearest_psd, project_psd
-from porqua_tpu.utils.helpers import to_numpy, serialize_solution, output_to_strategies
+from porqua_tpu.utils.helpers import (
+    calculate_mape,
+    calculate_rmse,
+    output_to_strategies,
+    serialize_solution,
+    show_result,
+    to_numpy,
+)
 
 __all__ = [
     "is_psd",
@@ -8,4 +15,7 @@ __all__ = [
     "to_numpy",
     "serialize_solution",
     "output_to_strategies",
+    "calculate_rmse",
+    "calculate_mape",
+    "show_result",
 ]

@@ -74,3 +74,36 @@ def calculate_mape(y_true, y_pred) -> float:
     y_true = np.asarray(y_true, dtype=float)
     y_pred = to_numpy(y_pred).astype(float)
     return float(np.mean(np.abs((y_true - y_pred) / y_true)) * 100)
+
+
+def show_result(predictions, y_test, y_actual, method=None):
+    """Print RMSE/MAPE and plot predictions vs actuals (reference
+    ``helper_functions.py:119-129``). The plot is skipped — with a
+    warning rather than an import crash — when matplotlib is absent
+    or headless plotting is unavailable."""
+    print(f"RMSE of {method or 'regression'}: "
+          f"{calculate_rmse(y_test, predictions)}")
+    print(f"MAPE of {method or 'regression'}: "
+          f"{calculate_mape(y_test, predictions)}")
+    try:
+        import sys
+
+        import matplotlib
+        if "matplotlib.pyplot" not in sys.modules:
+            # No backend in use yet: pick the headless one so this works
+            # under pytest/CI. Never switch an already-active backend —
+            # that would hijack an interactive (notebook) session.
+            matplotlib.use("Agg", force=False)
+        import matplotlib.pyplot as plt
+    except Exception as e:  # pragma: no cover - environment-dependent
+        print(f"(plot skipped: matplotlib unavailable: {e})")
+        return None
+    fig, ax = plt.subplots()
+    ax.plot(np.asarray(y_actual, dtype=float), color="cyan",
+            label="True values")
+    ax.plot(to_numpy(predictions).astype(float), color="green",
+            label="Prediction")
+    ax.legend()
+    if method:
+        ax.set_title(method)
+    return fig

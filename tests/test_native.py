@@ -66,3 +66,22 @@ def test_native_max_iter_reports():
     u = np.concatenate([np.full(n, np.inf), np.zeros(n)])
     sol = solve_qp_native(np.eye(n), np.zeros(n), C, l, u, max_iter=500)
     assert sol.status == Status.MAX_ITER  # infeasible -> cannot converge
+
+
+def test_so_cache_falls_back_when_package_dir_readonly(monkeypatch, tmp_path):
+    """A wheel installed into a read-only site-packages must still build
+    and cache the native core — under the user cache dir (isolated to
+    tmp_path here), keyed by source+arch so a stale or foreign-host
+    binary is never reused."""
+    import os
+
+    import porqua_tpu.native as nat
+
+    monkeypatch.setenv("XDG_CACHE_HOME", str(tmp_path))
+    monkeypatch.setattr(nat.os, "access", lambda p, m: False)
+    path = nat._so_path()
+    assert path.startswith(str(tmp_path))
+    assert not path.startswith(os.path.dirname(nat.__file__))
+    # Same source + arch -> same key; the name embeds the hash.
+    assert path == nat._so_path()
+    assert os.path.basename(path).startswith("libporqua_qp-")

@@ -86,3 +86,20 @@ def test_boosted_regression_grid_search(panel):
     pred = est.predict(X[300:])
     # learns real structure on held-out data
     assert calculate_rmse(y[300:], pred) < np.std(y[300:])
+
+
+def test_show_result_reports_and_returns_figure(panel, capsys):
+    """Reference ``helper_functions.py:119-129`` parity: RMSE/MAPE are
+    printed and a figure of prediction vs actual is produced (returned,
+    not shown — headless environments)."""
+    import pandas as pd
+
+    pytest.importorskip("matplotlib")
+    from porqua_tpu.utils.helpers import show_result
+
+    X, y, _ = panel
+    pred = OLS().fit(X, y).predict(X)
+    fig = show_result(pd.Series(pred), y, y, method="OLS")
+    out = capsys.readouterr().out
+    assert "RMSE of OLS" in out and "MAPE of OLS" in out
+    assert fig is not None and fig.axes[0].get_title() == "OLS"
