@@ -1,0 +1,105 @@
+"""Gradient-based hyperparameter tuning THROUGH the QP solver.
+
+The reference tunes hyperparameters (ridge strength, turnover penalty,
+box widths) by grid search over whole backtests — its solver boundary
+(``src/qp_problems.py:211``) is opaque to derivatives. Here the solve
+is differentiable (``porqua_tpu.qp.diff``, implicit-function vjp), so
+"pick the ridge that minimizes NEXT-window tracking error" is a
+first-order optimization: every gradient step backpropagates through
+objective assembly -> batched QP solve -> out-of-sample tracking error,
+all inside one jitted XLA program.
+
+Run: python examples/differentiable_tuning.py  (CPU, ~30 s)
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp
+import numpy as np
+
+from porqua_tpu.qp.canonical import CanonicalQP
+from porqua_tpu.qp.diff import solve_qp_diff
+from porqua_tpu.qp.solve import SolverParams
+
+PARAMS = SolverParams(max_iter=20000, eps_abs=1e-10, eps_rel=1e-10)
+
+
+def make_panel(rng, n_dates=12, T=60, n=24, noise=0.004):
+    """Rolling factor-model windows with noisy observations: in-sample
+    LS overfits, so an out-of-sample-optimal ridge exists."""
+    w_true = rng.dirichlet(np.ones(n))
+    Xs = rng.standard_normal((n_dates, 2 * T, n)) * 0.01
+    ys = Xs @ w_true + rng.standard_normal((n_dates, 2 * T)) * noise
+    # Fit window = first T rows, evaluation window = next T rows.
+    return (jnp.asarray(Xs[:, :T]), jnp.asarray(ys[:, :T]),
+            jnp.asarray(Xs[:, T:]), jnp.asarray(ys[:, T:]))
+
+
+def build_qp(X, y, ridge):
+    n = X.shape[-1]
+    dtype = X.dtype
+    return CanonicalQP(
+        P=2.0 * X.T @ X + 2.0 * ridge * jnp.eye(n, dtype=dtype),
+        q=-2.0 * X.T @ y,
+        C=jnp.ones((1, n), dtype), l=jnp.ones(1, dtype),
+        u=jnp.ones(1, dtype),
+        lb=jnp.zeros(n, dtype), ub=jnp.ones(n, dtype),
+        var_mask=jnp.ones(n, dtype), row_mask=jnp.ones(1, dtype),
+        constant=jnp.dot(y, y),
+    )
+
+
+def main():
+    rng = np.random.default_rng(7)
+    X_fit, y_fit, X_oos, y_oos = make_panel(rng)
+
+    @jax.jit
+    def oos_te(log_ridge):
+        """Median-free smooth objective: mean out-of-sample tracking
+        error over the date batch, as a function of log10(ridge)."""
+        ridge = 10.0 ** log_ridge
+
+        def one(Xf, yf, Xo, yo):
+            w = solve_qp_diff(build_qp(Xf, yf, ridge), PARAMS)
+            r = Xo @ w - yo
+            return jnp.sqrt(jnp.mean(r * r))
+
+        return jnp.mean(jax.vmap(one)(X_fit, y_fit, X_oos, y_oos))
+
+    grad = jax.jit(jax.grad(oos_te))
+
+    # Plain gradient descent on log10(ridge). The landscape is gentle
+    # (TE moves ~1e-5 per log-unit), so the raw gradient needs a large
+    # learning rate with a trust-region-style step cap.
+    log_r = jnp.asarray(-5.0, jnp.float64)
+    print(f"start: ridge=1e{float(log_r):.2f} "
+          f"oos_te={float(oos_te(log_r)):.6e}")
+    lr, cap = 2e4, 0.5
+    for step in range(40):
+        g = grad(log_r)
+        log_r = log_r - jnp.clip(lr * g, -cap, cap)
+    te_tuned = float(oos_te(log_r))
+    print(f"tuned: ridge=1e{float(log_r):.2f} oos_te={te_tuned:.6e}")
+
+    # Compare against a coarse grid — the reference's only option.
+    grid = [-7.0, -6.0, -5.0, -4.0, -3.0, -2.0, -1.0]
+    tes = [float(oos_te(jnp.asarray(g, jnp.float64))) for g in grid]
+    best = int(np.argmin(tes))
+    print("grid  :", ", ".join(f"1e{g:.0f}->{t:.3e}"
+                               for g, t in zip(grid, tes)))
+    print(f"grid best: ridge=1e{grid[best]:.0f} oos_te={tes[best]:.6e}")
+    assert te_tuned <= tes[best] * 1.02, (
+        "gradient tuning should match or beat the coarse grid")
+    print("OK: gradient-tuned ridge matches/beats the grid search")
+
+
+if __name__ == "__main__":
+    main()

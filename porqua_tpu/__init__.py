@@ -25,6 +25,7 @@ __version__ = "0.1.0"
 
 from porqua_tpu.constraints import Constraints
 from porqua_tpu.qp.canonical import CanonicalQP
+from porqua_tpu.qp.diff import solve_qp_diff
 from porqua_tpu.qp.solve import solve_qp, solve_qp_batch, QPSolution, SolverParams
 from porqua_tpu.estimators.covariance import Covariance, CovarianceSpecification
 from porqua_tpu.estimators.mean import MeanEstimator
@@ -60,6 +61,7 @@ __all__ = [
     "CanonicalQP",
     "solve_qp",
     "solve_qp_batch",
+    "solve_qp_diff",
     "QPSolution",
     "SolverParams",
     "Covariance",

@@ -36,20 +36,19 @@ def _so_path() -> str:
     else a per-user cache dir (wheels installed into a read-only or
     root-owned site-packages must still work for unprivileged users).
 
-    The user-cache filename carries a hash of the source and the host
-    arch: wheel timestamps are unreliable (SOURCE_DATE_EPOCH), so an
-    mtime check alone would happily reuse a binary built from an older
-    release — or, on an NFS-shared home, one compiled with
-    ``-march=native`` for a different machine."""
+    The user-cache filename carries a hash of the source: wheel
+    timestamps are unreliable (SOURCE_DATE_EPOCH), so an mtime check
+    alone would happily reuse a binary built from an older release.
+    Shared-cache builds also drop ``-march=native`` (see
+    :func:`_compile_flags`) — ``platform`` gives no reliable
+    microarchitecture key, and an NFS-shared home must never serve one
+    host's AVX-512 build to another host without it."""
     if os.access(_DIR, os.W_OK):
         return _SO
     import hashlib
-    import platform
 
     with open(_SRC, "rb") as fh:
         key = hashlib.sha256(fh.read())
-    key.update(platform.machine().encode())
-    key.update(platform.processor().encode())
     cache = os.path.join(
         os.environ.get("XDG_CACHE_HOME",
                        os.path.join(os.path.expanduser("~"), ".cache")),
@@ -58,18 +57,37 @@ def _so_path() -> str:
     return os.path.join(cache, f"libporqua_qp-{key.hexdigest()[:16]}.so")
 
 
+def _compile_flags(so: str) -> list:
+    """``-march=native`` only for the build cached next to the source
+    (single-machine by construction); the user-cache build stays on the
+    portable baseline so a shared home never serves a foreign-host
+    binary that SIGILLs."""
+    flags = ["-O3", "-fPIC", "-shared", "-std=c++17"]
+    if so == _SO:
+        flags.insert(1, "-march=native")
+    return flags
+
+
 def build_library(force: bool = False) -> str:
-    """Compile qp_solver.cpp to a shared library (cached)."""
+    """Compile qp_solver.cpp to a shared library (cached).
+
+    The compile targets a temp file that is atomically renamed into
+    place: the in-process lock cannot serialize OTHER processes (a job
+    array or pytest-xdist sharing the cache), and dlopen of a
+    half-written .so is a segfault."""
     so = _so_path()
     with _lock:
         if force or not os.path.exists(so) or (
             os.path.getmtime(so) < os.path.getmtime(_SRC)
         ):
-            cmd = [
-                "g++", "-O3", "-march=native", "-fPIC", "-shared",
-                "-std=c++17", _SRC, "-o", so,
-            ]
-            subprocess.run(cmd, check=True, capture_output=True)
+            tmp = f"{so}.build-{os.getpid()}"
+            cmd = ["g++", *_compile_flags(so), _SRC, "-o", tmp]
+            try:
+                subprocess.run(cmd, check=True, capture_output=True)
+                os.replace(tmp, so)
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
     return so
 
 

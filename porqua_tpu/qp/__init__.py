@@ -1,4 +1,5 @@
 from porqua_tpu.qp.canonical import CanonicalQP, stack_qps
+from porqua_tpu.qp.diff import solve_qp_diff
 from porqua_tpu.qp.solve import solve_qp, solve_qp_batch, QPSolution, SolverParams, Status
 
 __all__ = [
@@ -6,6 +7,7 @@ __all__ = [
     "stack_qps",
     "solve_qp",
     "solve_qp_batch",
+    "solve_qp_diff",
     "QPSolution",
     "SolverParams",
     "Status",

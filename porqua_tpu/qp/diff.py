@@ -1,0 +1,191 @@
+"""Differentiable QP solve: gradients through the optimizer.
+
+The reference's solver boundary is a black box — ``qpsolvers`` hands
+back a float array and the chain rule stops there (reference
+``src/qp_problems.py:211``). Here the solve is an implicit function of
+its inputs, so hyperparameters that *shape the problem* — ridge
+strength, covariance shrinkage, transaction-cost weights, constraint
+bounds — can be tuned by gradient descent through the whole backtest
+(objective assembly -> batched solve -> tracking error), all in one
+XLA program.
+
+Method (Amos & Kolter's OptNet sensitivity / standard NLP sensitivity):
+at a solution with active set A fixed and strict complementarity, x*
+solves the equality-constrained KKT system
+
+    [P     C_A'  E_A'] [x ]   [-q ]
+    [C_A   0     0   ] [nu] = [bC ]   (active general rows)
+    [E_A   0     0   ] [tau]  [bB ]   (active box coordinates)
+
+and the solution map's vjp needs one solve with the SAME (symmetric)
+KKT operator: K [u, wC, wB] = [g, 0, 0] for the incoming cotangent g.
+The solve reuses the polish's penalty-Schur + iterative-refinement
+scheme (``qp/polish.py``): M = P + delta I + (1/delta)(C'aC C + aB),
+with refinement against the unperturbed KKT residuals, so the adjoint
+is as accurate as the polish itself. Cotangents follow from
+F(x, nu, tau; theta) = 0:
+
+    q_bar  = -u
+    P_bar  = -(u x' + x u') / 2            (P symmetric)
+    C_bar  = -(nu u' + wC x')              (zero on inactive rows)
+    bound_bar = +wC / +wB, routed to l/u (lb/ub) by the active side.
+
+Caveats, stated rather than hidden:
+
+* The map x*(theta) is piecewise-smooth; AT an active-set change the
+  gradient is a subgradient of the piece the classifier picks. Strict
+  complementarity is the differentiability condition, exactly as for
+  qpsolvers' own sensitivity results.
+* Native-L1 (prox) solves are not supported here — the L1 term's kink
+  set would need its own classification; lift the cost into the
+  objective for tuning runs instead.
+* Gradients are meaningful only where ``status == SOLVED``; the
+  backward pass zeroes cotangents of unsolved problems rather than
+  propagating garbage.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from porqua_tpu.qp.canonical import CanonicalQP
+from porqua_tpu.qp.polish import (
+    _kkt_solve_dense,
+    _kkt_solve_factored,
+    polish_capacitance_dim,
+)
+from porqua_tpu.qp.solve import QPSolution, SolverParams, Status, solve_qp
+
+__all__ = ["solve_qp_diff", "active_sets"]
+
+
+def active_sets(qp: CanonicalQP, sol: QPSolution):
+    """Classify active rows/box coordinates at a solution.
+
+    Same criterion family as the polish (dual sign with an
+    exact-on-bound proximity fallback, ``qp/polish.py``): a coordinate
+    is active when its dual is decisively signed or the primal sits on
+    the (finite) bound. Returns a 6-tuple ``(aC, bound_C, aB, bound_B,
+    up_side_C, up_side_B)``: float {0,1} active masks, the active-side
+    bound values (0 where inactive or the bound is infinite), and the
+    boolean which-side indicators the bound cotangent routing uses.
+    """
+    dtype = qp.P.dtype
+    tiny = 1e3 * jnp.asarray(jnp.finfo(dtype).eps, dtype)
+    prox = jnp.maximum(tiny, 10.0 * jnp.maximum(sol.prim_res, sol.dual_res))
+
+    act_low_C = (sol.y < -tiny) | (jnp.isfinite(qp.l) & (sol.z - qp.l <= prox))
+    act_up_C = (sol.y > tiny) | (jnp.isfinite(qp.u) & (qp.u - sol.z <= prox))
+    eq_C = jnp.isfinite(qp.l) & jnp.isfinite(qp.u) & ((qp.u - qp.l) <= 1e-10)
+    aC = ((act_low_C | act_up_C | eq_C) & (qp.row_mask > 0)).astype(dtype)
+    up_side_C = act_up_C & ~act_low_C
+    bound_C = jnp.where(up_side_C, qp.u, qp.l)
+    bound_C = jnp.where(jnp.isfinite(bound_C), bound_C, 0.0) * aC
+
+    act_low_B = (sol.mu < -tiny) | (
+        jnp.isfinite(qp.lb) & (sol.x - qp.lb <= prox))
+    act_up_B = (sol.mu > tiny) | (
+        jnp.isfinite(qp.ub) & (qp.ub - sol.x <= prox))
+    eq_B = jnp.isfinite(qp.lb) & jnp.isfinite(qp.ub) & (
+        (qp.ub - qp.lb) <= 1e-10)
+    aB = ((act_low_B | act_up_B | eq_B) & (qp.var_mask > 0)).astype(dtype)
+    up_side_B = act_up_B & ~act_low_B
+    bound_B = jnp.where(up_side_B, qp.ub, qp.lb)
+    bound_B = jnp.where(jnp.isfinite(bound_B), bound_B, 0.0) * aB
+    return aC, bound_C, aB, bound_B, up_side_C, up_side_B
+
+
+def _adjoint_kkt_solve(qp: CanonicalQP, params: SolverParams, aC, aB, g):
+    """Solve the symmetric active-set KKT adjoint system
+
+        P u + C'(aC*wC) + aB*wB = g,   aC*(C u) = 0,   aB*u = 0
+
+    This is exactly the polish's equality-KKT system with the rhs
+    ``-q_eff`` replaced by the cotangent ``g`` and all active bounds at
+    zero — so it dispatches to the SAME solvers the polish uses
+    (``qp/polish.py``): the exact-pinning capacitance path when the
+    objective factor pays (``qp.Pf``, (r+m)-dim factorizations), the
+    dense penalty-Schur + refinement otherwise. The adjoint therefore
+    inherits the polish's cost profile and accuracy, and a fix in
+    either solver reaches the gradient path automatically.
+    """
+    dtype = qp.P.dtype
+    delta = jnp.maximum(
+        jnp.asarray(params.polish_delta, dtype),
+        jnp.sqrt(jnp.asarray(jnp.finfo(dtype).eps, dtype)))
+    zero_b = jnp.zeros(qp.n, dtype)
+    zero_c = jnp.zeros(qp.m, dtype)
+    solver = (_kkt_solve_factored
+              if polish_capacitance_dim(qp) is not None
+              else _kkt_solve_dense)
+    return solver(qp, params, aB, aC, zero_b, zero_c, -g, delta)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def solve_qp_diff(qp: CanonicalQP, params: SolverParams) -> jax.Array:
+    """``solve_qp(qp, params).x`` with an implicit-function vjp.
+
+    Differentiable in ``P, q, C, l, u, lb, ub`` (the ``Pf``/``Pdiag``
+    factor leaves get zero cotangents: ``P`` alone determines the
+    solution, the factor is a computational alias — gradients w.r.t.
+    data that built both flow through the ``P`` path). Compose with
+    ``jax.vmap`` for batches and ``jax.grad`` for tuning loops; see
+    ``tests/test_diff.py`` and ``examples/differentiable_tuning.py``.
+    """
+    return solve_qp(qp, params).x
+
+
+def _fwd(qp: CanonicalQP, params: SolverParams):
+    sol = solve_qp(qp, params)
+    return sol.x, (qp, sol)
+
+
+def _bwd(params: SolverParams, res, g):
+    qp, sol = res
+    dtype = qp.P.dtype
+    # Unsolved problems have no meaningful sensitivity; zero their
+    # cotangent instead of backpropagating a garbage KKT solve.
+    ok = (sol.status == Status.SOLVED).astype(dtype)
+    g = g * ok
+
+    aC, _, aB, _, up_side_C, up_side_B = active_sets(qp, sol)
+    u, wC, wB = _adjoint_kkt_solve(qp, params, aC, aB, g)
+
+    x = sol.x
+    nu = aC * sol.y
+    P_bar = -0.5 * (jnp.outer(u, x) + jnp.outer(x, u))
+    q_bar = -u
+    C_bar = -(jnp.outer(nu, u) + jnp.outer(wC, x))
+    # Bound cotangents: +w on the active side (F2 = aC*(Cx - bound) has
+    # d/dbound = -aC, so bound_bar = +wC; likewise box). Equality rows
+    # (l == u) classify as lower-side by convention — their cotangent
+    # lands on l; callers moving an equality bound move both l and u
+    # together, so the total differential is identical.
+    zero_m = jnp.zeros(qp.m, dtype)
+    zero_n = jnp.zeros(qp.n, dtype)
+    l_bar = jnp.where(up_side_C, zero_m, wC)
+    u_bar = jnp.where(up_side_C, wC, zero_m)
+    lb_bar = jnp.where(up_side_B, zero_n, wB)
+    ub_bar = jnp.where(up_side_B, wB, zero_n)
+
+    qp_bar = CanonicalQP(
+        P=P_bar,
+        q=q_bar,
+        C=C_bar,
+        l=l_bar,
+        u=u_bar,
+        lb=lb_bar,
+        ub=ub_bar,
+        var_mask=jnp.zeros_like(qp.var_mask),
+        row_mask=jnp.zeros_like(qp.row_mask),
+        constant=jnp.zeros_like(qp.constant),
+        Pf=None if qp.Pf is None else jnp.zeros_like(qp.Pf),
+        Pdiag=None if qp.Pdiag is None else jnp.zeros_like(qp.Pdiag),
+    )
+    return (qp_bar,)
+
+
+solve_qp_diff.defvjp(_fwd, _bwd)

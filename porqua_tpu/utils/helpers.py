@@ -86,19 +86,16 @@ def show_result(predictions, y_test, y_actual, method=None):
     print(f"MAPE of {method or 'regression'}: "
           f"{calculate_mape(y_test, predictions)}")
     try:
-        import sys
-
-        import matplotlib
-        if "matplotlib.pyplot" not in sys.modules:
-            # No backend in use yet: pick the headless one so this works
-            # under pytest/CI. Never switch an already-active backend —
-            # that would hijack an interactive (notebook) session.
-            matplotlib.use("Agg", force=False)
-        import matplotlib.pyplot as plt
+        # Build the Figure directly — no pyplot: nothing is registered
+        # in the global figure manager (no leak warnings in loops), no
+        # backend is selected or switched (an interactive session keeps
+        # its GUI backend; headless CI needs none at all).
+        from matplotlib.figure import Figure
     except Exception as e:  # pragma: no cover - environment-dependent
         print(f"(plot skipped: matplotlib unavailable: {e})")
         return None
-    fig, ax = plt.subplots()
+    fig = Figure()
+    ax = fig.subplots()
     ax.plot(np.asarray(y_actual, dtype=float), color="cyan",
             label="True values")
     ax.plot(to_numpy(predictions).astype(float), color="green",

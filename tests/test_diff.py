@@ -1,0 +1,284 @@
+"""Differentiable-solve gradient checks (``porqua_tpu/qp/diff.py``).
+
+Every gradient is validated against central finite differences of the
+full solver in f64 — the implicit-function vjp must agree with "solve
+the perturbed problem" wherever the active set is stable. The
+reference cannot do any of this: its solver boundary
+(``src/qp_problems.py:211``) is opaque to autodiff.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from porqua_tpu.qp.canonical import CanonicalQP
+from porqua_tpu.qp.diff import solve_qp_diff
+from porqua_tpu.qp.solve import SolverParams, Status, solve_qp
+
+PARAMS = SolverParams(max_iter=50000, eps_abs=1e-11, eps_rel=1e-11)
+
+
+def _tracking_problem(rng, n=8, T=24, ub=0.4):
+    """Strictly convex tracking QP: budget equality + box, a few box
+    actives at the solution (ub tight enough to bind)."""
+    X = rng.standard_normal((T, n)) * 0.1
+    w_true = rng.dirichlet(np.ones(n) * 0.5)
+    y = X @ w_true
+    return X, y, ub
+
+
+def _build_qp(X, y, ub, ridge=0.0):
+    n = X.shape[1]
+    dtype = X.dtype
+    P = 2.0 * X.T @ X + 2.0 * ridge * jnp.eye(n, dtype=dtype)
+    q = -2.0 * X.T @ y
+    return CanonicalQP(
+        P=P, q=q,
+        C=jnp.ones((1, n), dtype), l=jnp.ones(1, dtype),
+        u=jnp.ones(1, dtype),
+        lb=jnp.zeros(n, dtype), ub=jnp.full(n, ub, dtype),
+        var_mask=jnp.ones(n, dtype), row_mask=jnp.ones(1, dtype),
+        constant=jnp.dot(y, y),
+    )
+
+
+def _fd_grad(loss_of_theta, theta, h=1e-6):
+    g = np.zeros_like(theta)
+    flat = theta.reshape(-1)
+    for i in range(flat.size):
+        tp, tm = flat.copy(), flat.copy()
+        tp[i] += h
+        tm[i] -= h
+        g.reshape(-1)[i] = (
+            loss_of_theta(tp.reshape(theta.shape))
+            - loss_of_theta(tm.reshape(theta.shape))
+        ) / (2 * h)
+    return g
+
+
+@pytest.fixture(scope="module")
+def problem():
+    rng = np.random.default_rng(5)
+    X, y, ub = _tracking_problem(rng)
+    c = rng.standard_normal(X.shape[1])
+    return (jnp.asarray(X, jnp.float64), jnp.asarray(y, jnp.float64), ub,
+            jnp.asarray(c, jnp.float64))
+
+
+def test_solution_has_mixed_active_set(problem):
+    """Preflight: the fixture problem must bind some box bounds but not
+    all (else the gradient checks would not exercise both branches)."""
+    X, y, ub, _ = problem
+    sol = solve_qp(_build_qp(X, y, ub), PARAMS)
+    assert bool(sol.status == Status.SOLVED)
+    at_ub = int(np.sum(np.asarray(sol.x) > ub - 1e-8))
+    at_lb = int(np.sum(np.asarray(sol.x) < 1e-8))
+    assert at_ub + at_lb > 0
+    assert at_ub + at_lb < X.shape[1]
+
+
+def test_grad_q_matches_finite_differences(problem):
+    X, y, ub, c = problem
+    qp0 = _build_qp(X, y, ub)
+
+    def loss_jax(q):
+        return jnp.dot(c, solve_qp_diff(qp0._replace(q=q), PARAMS))
+
+    g = jax.grad(loss_jax)(qp0.q)
+
+    def loss_fd(q_np):
+        return float(jnp.dot(
+            c, solve_qp(qp0._replace(q=jnp.asarray(q_np)), PARAMS).x))
+
+    g_fd = _fd_grad(loss_fd, np.asarray(qp0.q))
+    np.testing.assert_allclose(np.asarray(g), g_fd, rtol=1e-5, atol=1e-7)
+
+
+def test_grad_ridge_through_P_matches_finite_differences(problem):
+    """The canonical tuning loop: d(loss)/d(ridge) flows through
+    P = 2 X'X + 2 ridge I."""
+    X, y, ub, c = problem
+
+    def loss_jax(ridge):
+        return jnp.dot(c, solve_qp_diff(_build_qp(X, y, ub, ridge), PARAMS))
+
+    g = float(jax.grad(loss_jax)(jnp.asarray(0.05, jnp.float64)))
+
+    h = 1e-6
+
+    def loss_at(r):
+        return float(jnp.dot(c, solve_qp(_build_qp(X, y, ub, r), PARAMS).x))
+
+    g_fd = (loss_at(0.05 + h) - loss_at(0.05 - h)) / (2 * h)
+    np.testing.assert_allclose(g, g_fd, rtol=1e-5)
+
+
+def test_grad_data_through_P_q_matches_finite_differences(problem):
+    """Gradients w.r.t. the raw return window X flow through BOTH
+    P = 2 X'X and q = -2 X'y simultaneously."""
+    X, y, ub, c = problem
+
+    def loss_jax(Xv):
+        return jnp.dot(c, solve_qp_diff(_build_qp(Xv, y, ub), PARAMS))
+
+    g = np.asarray(jax.grad(loss_jax)(X))
+
+    def loss_fd(X_np):
+        return float(jnp.dot(
+            c, solve_qp(_build_qp(jnp.asarray(X_np), y, ub), PARAMS).x))
+
+    # Spot-check a handful of entries (full (T, n) FD is slow).
+    rng = np.random.default_rng(0)
+    idx = [(int(i), int(j))
+           for i, j in zip(rng.integers(0, X.shape[0], 6),
+                           rng.integers(0, X.shape[1], 6))]
+    h = 1e-6
+    X_np = np.asarray(X)
+    for (i, j) in idx:
+        Xp, Xm = X_np.copy(), X_np.copy()
+        Xp[i, j] += h
+        Xm[i, j] -= h
+        fd = (loss_fd(Xp) - loss_fd(Xm)) / (2 * h)
+        np.testing.assert_allclose(g[i, j], fd, rtol=2e-4, atol=1e-7)
+
+
+def test_grad_active_bound_matches_fd_and_inactive_is_zero(problem):
+    X, y, ub, c = problem
+    qp0 = _build_qp(X, y, ub)
+    sol = solve_qp(qp0, PARAMS)
+    x = np.asarray(sol.x)
+    i_act = int(np.argmax(x))          # at ub (fixture guarantees one)
+    assert x[i_act] > ub - 1e-8
+    i_inact = int(np.argmin(np.abs(x - np.median(x))))  # strictly inside
+
+    def loss_jax(ub_vec):
+        return jnp.dot(c, solve_qp_diff(qp0._replace(ub=ub_vec), PARAMS))
+
+    g = np.asarray(jax.grad(loss_jax)(qp0.ub))
+
+    h = 1e-6
+
+    def loss_at(i, delta):
+        ub_v = np.asarray(qp0.ub).copy()
+        ub_v[i] += delta
+        return float(jnp.dot(
+            c, solve_qp(qp0._replace(ub=jnp.asarray(ub_v)), PARAMS).x))
+
+    fd_act = (loss_at(i_act, h) - loss_at(i_act, -h)) / (2 * h)
+    np.testing.assert_allclose(g[i_act], fd_act, rtol=1e-5, atol=1e-9)
+    assert abs(g[i_inact]) < 1e-8
+
+
+def test_grad_budget_bound_matches_finite_differences(problem):
+    """The equality row's bound (l == u == budget): move both together."""
+    X, y, ub, c = problem
+    qp0 = _build_qp(X, y, ub)
+
+    def loss_jax(budget):
+        b = jnp.full(1, budget, jnp.float64)
+        return jnp.dot(
+            c, solve_qp_diff(qp0._replace(l=b, u=b), PARAMS))
+
+    g = float(jax.grad(loss_jax)(jnp.asarray(1.0, jnp.float64)))
+
+    h = 1e-6
+
+    def loss_at(budget):
+        b = jnp.full(1, budget, jnp.float64)
+        return float(jnp.dot(
+            c, solve_qp(qp0._replace(l=b, u=b), PARAMS).x))
+
+    g_fd = (loss_at(1.0 + h) - loss_at(1.0 - h)) / (2 * h)
+    np.testing.assert_allclose(g, g_fd, rtol=1e-5)
+
+
+def test_vmap_grad_composes(problem):
+    """jax.vmap over a batch of dates + jax.grad through the summed
+    tracking error — the shape every tuning loop uses."""
+    X, y, ub, _ = problem
+    rng = np.random.default_rng(9)
+    Xs = jnp.asarray(rng.standard_normal((3,) + X.shape) * 0.1)
+    w_true = rng.dirichlet(np.ones(X.shape[1]))
+    ys = jnp.einsum("bti,i->bt", Xs, jnp.asarray(w_true))
+
+    def loss(ridge):
+        def one(Xb, yb):
+            xw = solve_qp_diff(_build_qp(Xb, yb, ub, ridge), PARAMS)
+            r = Xb @ xw - yb
+            return jnp.mean(r * r)
+        return jnp.sum(jax.vmap(one)(Xs, ys))
+
+    g = float(jax.grad(loss)(jnp.asarray(0.02, jnp.float64)))
+    h = 1e-6
+    g_fd = (float(loss(jnp.asarray(0.02 + h)))
+            - float(loss(jnp.asarray(0.02 - h)))) / (2 * h)
+    np.testing.assert_allclose(g, g_fd, rtol=1e-4)
+    # Ridge shrinks toward equal weight, away from the LS optimum: the
+    # tracking error must be increasing in ridge here.
+    assert g > 0
+
+
+def test_unsolved_problem_gets_zero_gradient(problem):
+    """Infeasible problem (box caps sum below the budget): status is
+    not SOLVED and the cotangent is zeroed, not garbage."""
+    X, y, _, c = problem
+    n = X.shape[1]
+    qp_bad = _build_qp(X, y, 0.05)  # sum(ub) = 0.4 < 1 = budget
+    short = SolverParams(max_iter=2000, eps_abs=1e-9, eps_rel=1e-9)
+
+    def loss_jax(q):
+        return jnp.dot(c, solve_qp_diff(qp_bad._replace(q=q), short))
+
+    sol = solve_qp(qp_bad, short)
+    assert not bool(sol.status == Status.SOLVED)
+    g = np.asarray(jax.grad(loss_jax)(qp_bad.q))
+    np.testing.assert_allclose(g, np.zeros(n), atol=0.0)
+
+
+def test_factored_adjoint_path_matches_finite_differences():
+    """When the objective carries its factor (Pf, capacitance dim
+    r + m < n), the adjoint dispatches to the exact-pinning factored
+    KKT solve — same machinery as the polish. Gradient parity with
+    finite differences pins that path specifically."""
+    rng = np.random.default_rng(17)
+    T, n = 16, 30
+    X = jnp.asarray(rng.standard_normal((T, n)) * 0.1)
+    w_true = rng.dirichlet(np.ones(n) * 0.5)
+    y = X @ jnp.asarray(w_true)
+    c = jnp.asarray(rng.standard_normal(n))
+
+    def build(q_shift):
+        dtype = X.dtype
+        P = 2.0 * X.T @ X + 0.02 * jnp.eye(n, dtype=dtype)
+        q = -2.0 * X.T @ y + q_shift
+        return CanonicalQP(
+            P=P, q=q,
+            C=jnp.ones((1, n), dtype), l=jnp.ones(1, dtype),
+            u=jnp.ones(1, dtype),
+            lb=jnp.zeros(n, dtype), ub=jnp.full(n, 0.2, dtype),
+            var_mask=jnp.ones(n, dtype), row_mask=jnp.ones(1, dtype),
+            constant=jnp.dot(y, y),
+            Pf=X, Pdiag=jnp.full(n, 0.02, dtype),
+        )
+
+    from porqua_tpu.qp.polish import polish_capacitance_dim
+    assert polish_capacitance_dim(build(jnp.zeros(n))) == T + 1
+
+    def loss_jax(q_shift):
+        return jnp.dot(c, solve_qp_diff(build(q_shift), PARAMS))
+
+    g = np.asarray(jax.grad(loss_jax)(jnp.zeros(n, jnp.float64)))
+
+    h = 1e-6
+
+    def loss_at(q_np):
+        return float(jnp.dot(
+            c, solve_qp(build(jnp.asarray(q_np)), PARAMS).x))
+
+    for i in [0, 7, 15, 29]:
+        qp_, qm_ = np.zeros(n), np.zeros(n)
+        qp_[i] += h
+        qm_[i] -= h
+        fd = (loss_at(qp_) - loss_at(qm_)) / (2 * h)
+        np.testing.assert_allclose(g[i], fd, rtol=1e-4, atol=1e-8)
