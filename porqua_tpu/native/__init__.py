@@ -46,9 +46,16 @@ def _so_path() -> str:
     if os.access(_DIR, os.W_OK):
         return _SO
     import hashlib
+    import platform
 
     with open(_SRC, "rb") as fh:
         key = hashlib.sha256(fh.read())
+    # ISA tag: an NFS-shared cache must never serve an x86_64 binary to
+    # an aarch64 host (CDLL would fail and, with the file present and
+    # fresh, never self-heal). Microarchitecture WITHIN the ISA is
+    # handled by dropping -march=native instead — platform gives no
+    # reliable key for it.
+    key.update(platform.machine().encode())
     cache = os.path.join(
         os.environ.get("XDG_CACHE_HOME",
                        os.path.join(os.path.expanduser("~"), ".cache")),

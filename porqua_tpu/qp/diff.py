@@ -55,6 +55,7 @@ from porqua_tpu.qp.canonical import CanonicalQP
 from porqua_tpu.qp.polish import (
     _kkt_solve_dense,
     _kkt_solve_factored,
+    classify_active,
     polish_capacitance_dim,
 )
 from porqua_tpu.qp.solve import QPSolution, SolverParams, Status, solve_qp
@@ -77,20 +78,13 @@ def active_sets(qp: CanonicalQP, sol: QPSolution):
     tiny = 1e3 * jnp.asarray(jnp.finfo(dtype).eps, dtype)
     prox = jnp.maximum(tiny, 10.0 * jnp.maximum(sol.prim_res, sol.dual_res))
 
-    act_low_C = (sol.y < -tiny) | (jnp.isfinite(qp.l) & (sol.z - qp.l <= prox))
-    act_up_C = (sol.y > tiny) | (jnp.isfinite(qp.u) & (qp.u - sol.z <= prox))
-    eq_C = jnp.isfinite(qp.l) & jnp.isfinite(qp.u) & ((qp.u - qp.l) <= 1e-10)
+    (act_low_C, act_up_C, eq_C, act_low_B, act_up_B, eq_B
+     ) = classify_active(qp, sol.z, sol.x, sol.y, sol.mu, prox, tiny)
     aC = ((act_low_C | act_up_C | eq_C) & (qp.row_mask > 0)).astype(dtype)
     up_side_C = act_up_C & ~act_low_C
     bound_C = jnp.where(up_side_C, qp.u, qp.l)
     bound_C = jnp.where(jnp.isfinite(bound_C), bound_C, 0.0) * aC
 
-    act_low_B = (sol.mu < -tiny) | (
-        jnp.isfinite(qp.lb) & (sol.x - qp.lb <= prox))
-    act_up_B = (sol.mu > tiny) | (
-        jnp.isfinite(qp.ub) & (qp.ub - sol.x <= prox))
-    eq_B = jnp.isfinite(qp.lb) & jnp.isfinite(qp.ub) & (
-        (qp.ub - qp.lb) <= 1e-10)
     aB = ((act_low_B | act_up_B | eq_B) & (qp.var_mask > 0)).astype(dtype)
     up_side_B = act_up_B & ~act_low_B
     bound_B = jnp.where(up_side_B, qp.ub, qp.lb)

@@ -132,6 +132,29 @@ def _kkt_solve_factored(qp: CanonicalQP, params: SolverParams,
     return x, aC_eff * nu, tau
 
 
+def classify_active(qp: CanonicalQP, zC, xB, y, mu, prox_tol, dual_tol):
+    """Shared active-set classification: dual sign (OSQP's criterion)
+    with an on-(finite-)bound proximity fallback, equality rows/boxes
+    always active. ``zC``/``xB`` are the row activities and box
+    variables of the point being classified (the ADMM iterate's ``z``/
+    ``w`` in the polish, the solution's ``Cx``/``x`` in the
+    differentiable-solve adjoint — both callers MUST share this logic
+    or forward polish and backward gradient drift apart on the same
+    point). Returns the raw pieces ``(act_low_C, act_up_C, eq_C,
+    act_low_B, act_up_B, eq_B)``; callers combine and mask.
+    """
+    act_low_C = (y < -dual_tol) | (jnp.isfinite(qp.l) & (zC - qp.l <= prox_tol))
+    act_up_C = (y > dual_tol) | (jnp.isfinite(qp.u) & (qp.u - zC <= prox_tol))
+    eq_C = jnp.isfinite(qp.l) & jnp.isfinite(qp.u) & ((qp.u - qp.l) <= 1e-10)
+    act_low_B = (mu < -dual_tol) | (
+        jnp.isfinite(qp.lb) & (xB - qp.lb <= prox_tol))
+    act_up_B = (mu > dual_tol) | (
+        jnp.isfinite(qp.ub) & (qp.ub - xB <= prox_tol))
+    eq_B = jnp.isfinite(qp.lb) & jnp.isfinite(qp.ub) & (
+        (qp.ub - qp.lb) <= 1e-10)
+    return act_low_C, act_up_C, eq_C, act_low_B, act_up_B, eq_B
+
+
 def _kkt_solve_dense(qp: CanonicalQP, params: SolverParams,
                      aB, aC, bound_B, bound_C, q_eff, delta):
     """Active-set KKT solve, dense penalty-Schur form.
@@ -311,19 +334,11 @@ def _polish_pass(qp: CanonicalQP,
         l1c = jnp.zeros(n, dtype)
         window = 10.0 * prox_err + tiny
         mu_box_est = mu
-    act_low_C = (y < -dual_tol) | (jnp.isfinite(qp.l) & (z - qp.l <= prox_tol))
-    act_up_C = (y > dual_tol) | (jnp.isfinite(qp.u) & (qp.u - z <= prox_tol))
-    # Equality rows are always active (l == u)
-    eq_C = jnp.isfinite(qp.l) & jnp.isfinite(qp.u) & ((qp.u - qp.l) <= 1e-10)
+    (act_low_C, act_up_C, eq_C, act_low_B, act_up_B, eq_B
+     ) = classify_active(qp, z, w, y, mu_box_est, prox_tol, dual_tol)
     act_C = (act_low_C | act_up_C | eq_C) & (qp.row_mask > 0)
     bound_C = jnp.where(act_up_C & ~act_low_C, qp.u, qp.l)
     bound_C = jnp.where(jnp.isfinite(bound_C), bound_C, 0.0)
-
-    act_low_B = (mu_box_est < -dual_tol) | (
-        jnp.isfinite(qp.lb) & (w - qp.lb <= prox_tol))
-    act_up_B = (mu_box_est > dual_tol) | (
-        jnp.isfinite(qp.ub) & (qp.ub - w <= prox_tol))
-    eq_B = jnp.isfinite(qp.lb) & jnp.isfinite(qp.ub) & ((qp.ub - qp.lb) <= 1e-10)
     bound_B = jnp.where(act_up_B & ~act_low_B, qp.ub, qp.lb)
     bound_B = jnp.where(jnp.isfinite(bound_B), bound_B, 0.0)
 

@@ -88,14 +88,30 @@ def main():
     # full tracking step, amortized the same way
     from porqua_tpu.qp.solve import SolverParams
     from porqua_tpu.tracking import tracking_step
-    # The round-3 bench config (bench.py): 1-pass polish, Ruiz x2.
-    params = SolverParams(max_iter=2000, eps_abs=1e-3, eps_rel=1e-3,
-                          polish_passes=1, scaling_iters=2)
-    per, floor = amortized(
-        lambda X: jnp.sum(tracking_step(X, ys, params).tracking_error), Xs,
-        k=4)
-    print(f"{'full tracking_step':20s} {per*1e3:8.2f} ms  "
-          f"(dispatch floor {floor*1e3:6.1f} ms)", flush=True)
+
+    def step_cfg(label, **kw):
+        params = SolverParams(max_iter=2000, eps_abs=1e-3, eps_rel=1e-3,
+                              **kw)
+        out = jax.jit(lambda X: tracking_step(X, ys, params))(Xs)
+        solved = int(jnp.sum(out.status == 1))
+        te = float(jnp.median(out.tracking_error))
+        per, floor = amortized(
+            lambda X: jnp.sum(tracking_step(X, ys, params).tracking_error),
+            Xs, k=4)
+        print(f"{label:20s} {per*1e3:8.2f} ms  "
+              f"(dispatch floor {floor*1e3:6.1f} ms)  "
+              f"solved {solved}/{B} TE {te:.4e}", flush=True)
+
+    # r3 configs, end to end. "step trinv r2cfg" was the round-2 bench
+    # config; the woodbury rows answer the NEXT perf question — how
+    # many Ruiz sweeps does the capacitance headline config actually
+    # need (each sweep rereads the 252 MB P batch), and what does the
+    # polish add on top of it.
+    step_cfg("step trinv r2cfg", polish_passes=1, scaling_iters=2)
+    step_cfg("step trinv ruiz2", polish=False, scaling_iters=2)
+    for si in (2, 1, 0):
+        step_cfg(f"step woodbury ruiz{si}", polish=False, scaling_iters=si,
+                 linsolve="woodbury", woodbury_refine=0, check_interval=35)
 
 
 def _blocked_trinv_stage(L):

@@ -282,3 +282,54 @@ def test_factored_adjoint_path_matches_finite_differences():
         qm_[i] -= h
         fd = (loss_at(qp_) - loss_at(qm_)) / (2 * h)
         np.testing.assert_allclose(g[i], fd, rtol=1e-4, atol=1e-8)
+
+
+def test_grad_constraint_matrix_matches_finite_differences():
+    """C_bar = -(nu u' + wC x') with an ACTIVE inequality row — the
+    least-trivial vjp formula, pinned against finite differences (the
+    other tests hold C fixed)."""
+    rng = np.random.default_rng(23)
+    n, T = 6, 18
+    X = jnp.asarray(rng.standard_normal((T, n)) * 0.1)
+    w_true = rng.dirichlet(np.ones(n))
+    y = X @ jnp.asarray(w_true)
+    c = jnp.asarray(rng.standard_normal(n))
+    # Rows: budget equality + a sector-cap inequality tight enough to
+    # bind (sum of first three weights <= cap below their LS optimum).
+    sector = jnp.asarray(np.array([1.0, 1.0, 1.0, 0, 0, 0]))
+
+    def build(C2):
+        dtype = X.dtype
+        C = jnp.stack([jnp.ones(n, dtype), C2])
+        inf = jnp.asarray(jnp.inf, dtype)
+        return CanonicalQP(
+            P=2.0 * X.T @ X + 0.01 * jnp.eye(n, dtype=dtype),
+            q=-2.0 * X.T @ y,
+            C=C, l=jnp.asarray([1.0, -jnp.inf]), u=jnp.asarray([1.0, 0.35]),
+            lb=jnp.full(n, -inf), ub=jnp.full(n, inf),
+            var_mask=jnp.ones(n, dtype), row_mask=jnp.ones(2, dtype),
+            constant=jnp.dot(y, y),
+        )
+
+    sol = solve_qp(build(sector), PARAMS)
+    assert bool(sol.status == Status.SOLVED)
+    # The cap must actually bind for the test to exercise C_bar.
+    assert abs(float(sol.z[1]) - 0.35) < 1e-7, float(sol.z[1])
+
+    def loss_jax(C2):
+        return jnp.dot(c, solve_qp_diff(build(C2), PARAMS))
+
+    g = np.asarray(jax.grad(loss_jax)(sector))
+
+    h = 1e-6
+
+    def loss_at(C2_np):
+        return float(jnp.dot(c, solve_qp(build(jnp.asarray(C2_np)), PARAMS).x))
+
+    s_np = np.asarray(sector)
+    for i in range(n):
+        cp, cm = s_np.copy(), s_np.copy()
+        cp[i] += h
+        cm[i] -= h
+        fd = (loss_at(cp) - loss_at(cm)) / (2 * h)
+        np.testing.assert_allclose(g[i], fd, rtol=1e-4, atol=1e-8)
