@@ -99,11 +99,15 @@ def main():
     # iterate seen cannot.
     lr, cap = 2e3, 0.2
     best_log, best_net = float(log_l), float(net_loss(log_l))
-    for _ in range(60):
+    for step in range(60):
         v, g = loss_and_grad(log_l)
         if float(v) < best_net:
             best_net, best_log = float(v), float(log_l)
         log_l = log_l - jnp.clip(lr * g, -cap, cap)
+    # The loop scores iterates 0..59; score the final update too.
+    v_last = float(net_loss(log_l))
+    if v_last < best_net:
+        best_net, best_log = v_last, float(log_l)
     net_tuned = best_net
     print(f"tuned: lambda=1e{best_log:.2f} net={net_tuned:.6e}")
 
