@@ -27,7 +27,7 @@ from porqua_tpu import (  # noqa: E402
     OptimizationItemBuilder,
     SelectionItemBuilder,
 )
-from porqua_tpu.accounting import simulate_strategy  # noqa: E402
+from porqua_tpu.accounting import performance_summary, simulate_strategy  # noqa: E402
 from porqua_tpu.batch import run_batch  # noqa: E402
 from porqua_tpu.builders import (  # noqa: E402
     bibfn_bm_series,
@@ -92,15 +92,11 @@ def main():
           f"dates in {wall:.2f}s (build + one XLA program)")
 
     sim = simulate_strategy(bt.strategy, X, fc=0.0, vc=0.001)
-    bm_ret = bm.iloc[:, 0].reindex(sim.index)
-    ann = 252
-    sharpe = float(sim.mean() / sim.std() * np.sqrt(ann))
-    levels = (1 + sim).cumprod()
-    mdd = float((levels / levels.cummax() - 1).min())
-    var95 = float(sim.quantile(0.05))
-    te = float((sim - bm_ret).std() * np.sqrt(ann))
-    print(f"Sharpe {sharpe:.2f} | max drawdown {mdd:.2%} | "
-          f"daily VaR(95) {var95:.4f} | tracking error {te:.4f}")
+    perf = performance_summary(sim, benchmark=bm.iloc[:, 0])
+    print(f"Sharpe {perf['sharpe']:.2f} | "
+          f"max drawdown {perf['max_drawdown']:.2%} | "
+          f"daily VaR(95) {perf['var_95']:.4f} | "
+          f"tracking error {perf['tracking_error']:.4f}")
 
 
 if __name__ == "__main__":

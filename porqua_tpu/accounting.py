@@ -216,3 +216,60 @@ def simulate_strategy(strategy,
     ret = np.asarray(out.returns)
     valid = np.asarray(out.valid)
     return pd.Series(ret[valid], index=return_series.index[valid])
+
+
+def performance_summary(returns: pd.Series,
+                        benchmark: Optional[pd.Series] = None,
+                        n_days_per_year: int = 252) -> dict:
+    """Per-strategy performance report: the quantstats-style metric set
+    the reference notebooks print (Sharpe / VaR / drawdown,
+    ``example/backtest.ipynb`` cell 2, ``index_replication.ipynb`` cell
+    11) computed from first principles — no external dependency.
+
+    Returns a dict with annualized return/volatility/Sharpe, max
+    drawdown (on the compounded level path), daily 95% historical VaR,
+    cumulative return, and — when a benchmark series is given —
+    annualized tracking error, beta, and active (excess) return.
+    """
+    r = returns.dropna()
+    ann = float(n_days_per_year)
+    if r.empty:
+        # A strategy with no valid days has no performance — report it
+        # as NaN metrics, not an IndexError.
+        nan = float("nan")
+        out = {"n_days": 0, "annual_return": nan, "annual_volatility": nan,
+               "sharpe": nan, "max_drawdown": nan, "var_95": nan,
+               "cumulative_return": nan}
+        if benchmark is not None:
+            out.update(tracking_error=nan, beta=nan, active_return=nan)
+        return out
+    mean_d, std_d = float(r.mean()), float(r.std())
+    levels = (1.0 + r).cumprod()
+    out = {
+        "n_days": int(r.size),
+        "annual_return": float((1.0 + mean_d) ** ann - 1.0),
+        "annual_volatility": std_d * float(np.sqrt(ann)),
+        # A zero/undefined-variance series has no defined risk-adjusted
+        # return; NaN, never +inf for a flat losing strategy.
+        "sharpe": (mean_d / std_d * float(np.sqrt(ann))
+                   if std_d > 0 else float("nan")),
+        "max_drawdown": float((levels / levels.cummax() - 1.0).min()),
+        "var_95": float(r.quantile(0.05)),
+        "cumulative_return": float(levels.iloc[-1] - 1.0),
+    }
+    if benchmark is not None:
+        # One aligned, pairwise-complete sample for every benchmark
+        # metric: covariance and variance from different subsets would
+        # bias beta whenever the two series' calendars differ.
+        pair = pd.DataFrame(
+            {"r": r, "b": benchmark.reindex(r.index).astype(float)}
+        ).dropna()
+        active = pair["r"] - pair["b"]
+        bv = float(pair["b"].var())
+        out["tracking_error"] = float(active.std() * np.sqrt(ann))
+        out["beta"] = (float(pair["r"].cov(pair["b"]) / bv)
+                       if bv > 0 else float("nan"))
+        out["active_return"] = (
+            float((1.0 + active.mean()) ** ann - 1.0)
+            if len(active) else float("nan"))
+    return out

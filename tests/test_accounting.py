@@ -157,3 +157,49 @@ def test_drift_weights_matches_floating_weights(rng):
             jnp.asarray([10]), rescale=rescale)
         np.testing.assert_allclose(
             np.asarray(dev)[10:61], ref.to_numpy(), rtol=1e-9, atol=1e-12)
+
+
+def test_performance_summary_metrics(rng):
+    """Sharpe/vol/drawdown/VaR against hand-computed values on a known
+    series; benchmark block adds TE/beta/active return (the
+    quantstats-style set the reference notebooks print)."""
+    from porqua_tpu.accounting import performance_summary
+
+    r = pd.Series(
+        rng.standard_normal(500) * 0.01 + 0.0004,
+        index=pd.bdate_range("2020-01-01", periods=500))
+    bench = 0.8 * r + pd.Series(
+        rng.standard_normal(500) * 0.004,
+        index=r.index)
+    perf = performance_summary(r, benchmark=bench)
+
+    assert perf["n_days"] == 500
+    np.testing.assert_allclose(
+        perf["sharpe"], r.mean() / r.std() * np.sqrt(252), rtol=1e-12)
+    levels = (1 + r).cumprod()
+    np.testing.assert_allclose(
+        perf["max_drawdown"], (levels / levels.cummax() - 1).min(),
+        rtol=1e-12)
+    np.testing.assert_allclose(perf["var_95"], r.quantile(0.05), rtol=1e-12)
+    np.testing.assert_allclose(
+        perf["cumulative_return"], levels.iloc[-1] - 1, rtol=1e-12)
+    np.testing.assert_allclose(
+        perf["tracking_error"], (r - bench).std() * np.sqrt(252),
+        rtol=1e-12)
+    np.testing.assert_allclose(
+        perf["beta"], r.cov(bench) / bench.var(), rtol=1e-12)
+
+
+def test_performance_summary_degenerate_series():
+    """Empty and flat series report NaN metrics, never crash or +inf."""
+    from porqua_tpu.accounting import performance_summary
+
+    empty = performance_summary(pd.Series([], dtype=float),
+                                benchmark=pd.Series([], dtype=float))
+    assert empty["n_days"] == 0 and np.isnan(empty["sharpe"])
+    assert np.isnan(empty["beta"])
+
+    flat = performance_summary(
+        pd.Series(-0.001, index=pd.bdate_range("2022-01-03", periods=50)))
+    assert np.isnan(flat["sharpe"])  # no variance -> undefined, not +inf
+    assert flat["cumulative_return"] < 0
