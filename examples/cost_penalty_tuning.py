@@ -121,6 +121,45 @@ def main():
         "gradient tuning should match or beat the grid")
     print("OK: gradient-tuned turnover penalty matches/beats the grid")
 
+    # The same gradient through the NATIVE n-variable prox path
+    # (solve_qp_l1_diff) — no 2n lift — must agree with the lifted one:
+    # two independent formulations of the identical piecewise-smooth
+    # solution map.
+    from porqua_tpu.qp.diff import solve_qp_l1_diff
+
+    def plain_qp(X, y):
+        n = X.shape[1]
+        dtype = X.dtype
+        return CanonicalQP(
+            P=2.0 * X.T @ X, q=-2.0 * X.T @ y,
+            C=jnp.ones((1, n), dtype), l=jnp.ones(1, dtype),
+            u=jnp.ones(1, dtype),
+            lb=jnp.zeros(n, dtype), ub=jnp.ones(n, dtype),
+            var_mask=jnp.ones(n, dtype), row_mask=jnp.ones(1, dtype),
+            constant=jnp.dot(y, y),
+        )
+
+    @jax.jit
+    def net_loss_native(log_lam):
+        lam = 10.0 ** log_lam
+
+        def one(Xf, yf, Xo, yo):
+            wv = solve_qp_l1_diff(
+                plain_qp(Xf, yf), jnp.full(N, lam, jnp.float64), w_prev,
+                PARAMS)
+            te = jnp.sqrt(jnp.mean((Xo @ wv - yo) ** 2))
+            return te + REAL_TC * jnp.sum(jnp.abs(wv - w_prev))
+
+        return jnp.mean(jax.vmap(one)(X_fit, y_fit, X_oos, y_oos))
+
+    probe = jnp.asarray(-3.2, jnp.float64)
+    g_lift = float(jax.grad(net_loss)(probe))
+    g_native = float(jax.grad(net_loss_native)(probe))
+    print(f"d(net)/d(log lambda) at 1e-3.2: lifted {g_lift:+.6e}, "
+          f"native prox {g_native:+.6e}")
+    assert abs(g_lift - g_native) <= 1e-6 + 1e-3 * abs(g_lift)
+    print("OK: native-prox gradient agrees with the lifted-QP gradient")
+
 
 if __name__ == "__main__":
     main()

@@ -21,11 +21,11 @@ optimization objectives, covariance/mean estimators, selection, item
 builders, backtest engine and portfolio accounting.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"  # keep in sync with pyproject.toml
 
 from porqua_tpu.constraints import Constraints
 from porqua_tpu.qp.canonical import CanonicalQP
-from porqua_tpu.qp.diff import solve_qp_diff
+from porqua_tpu.qp.diff import solve_qp_diff, solve_qp_l1_diff
 from porqua_tpu.qp.solve import solve_qp, solve_qp_batch, QPSolution, SolverParams
 from porqua_tpu.estimators.covariance import Covariance, CovarianceSpecification
 from porqua_tpu.estimators.mean import MeanEstimator
@@ -62,6 +62,7 @@ __all__ = [
     "solve_qp",
     "solve_qp_batch",
     "solve_qp_diff",
+    "solve_qp_l1_diff",
     "QPSolution",
     "SolverParams",
     "Covariance",

@@ -1,5 +1,5 @@
 from porqua_tpu.qp.canonical import CanonicalQP, stack_qps
-from porqua_tpu.qp.diff import solve_qp_diff
+from porqua_tpu.qp.diff import solve_qp_diff, solve_qp_l1_diff
 from porqua_tpu.qp.solve import solve_qp, solve_qp_batch, QPSolution, SolverParams, Status
 
 __all__ = [
@@ -8,6 +8,7 @@ __all__ = [
     "solve_qp",
     "solve_qp_batch",
     "solve_qp_diff",
+    "solve_qp_l1_diff",
     "QPSolution",
     "SolverParams",
     "Status",

@@ -36,9 +36,10 @@ Caveats, stated rather than hidden:
   gradient is a subgradient of the piece the classifier picks. Strict
   complementarity is the differentiability condition, exactly as for
   qpsolvers' own sensitivity results.
-* Native-L1 (prox) solves are not supported here — the L1 term's kink
-  set would need its own classification; lift the cost into the
-  objective for tuning runs instead.
+* Native-L1 (prox) solves have their own entry point,
+  :func:`solve_qp_l1_diff`, which adds the kink-set classification
+  (shared with the prox-aware polish) and cotangents for the L1
+  weights and centers.
 * Gradients are meaningful only where ``status == SOLVED``; the
   backward pass zeroes cotangents of unsolved problems rather than
   propagating garbage.
@@ -56,11 +57,12 @@ from porqua_tpu.qp.polish import (
     _kkt_solve_dense,
     _kkt_solve_factored,
     classify_active,
+    classify_l1,
     polish_capacitance_dim,
 )
 from porqua_tpu.qp.solve import QPSolution, SolverParams, Status, solve_qp
 
-__all__ = ["solve_qp_diff", "active_sets"]
+__all__ = ["solve_qp_diff", "solve_qp_l1_diff", "active_sets"]
 
 
 def active_sets(qp: CanonicalQP, sol: QPSolution):
@@ -132,6 +134,38 @@ def solve_qp_diff(qp: CanonicalQP, params: SolverParams) -> jax.Array:
     return solve_qp(qp, params).x
 
 
+def _qp_cotangents(qp, sol, u, wC, aC, up_side_C, lb_bar, ub_bar):
+    """Assemble the CanonicalQP cotangent shared by the smooth and
+    native-L1 vjps; callers supply their own box-bound routing.
+
+    Bound cotangents: +w on the active side (F2 = aC*(Cx - bound) has
+    d/dbound = -aC, so bound_bar = +wC; likewise box). Equality rows
+    (l == u) classify as lower-side by convention — their cotangent
+    lands on l; callers moving an equality bound move both l and u
+    together, so the total differential is identical.
+    """
+    dtype = qp.P.dtype
+    x = sol.x
+    nu = aC * sol.y
+    zero_m = jnp.zeros(qp.m, dtype)
+    l_bar = jnp.where(up_side_C, zero_m, wC)
+    u_bar = jnp.where(up_side_C, wC, zero_m)
+    return CanonicalQP(
+        P=-0.5 * (jnp.outer(u, x) + jnp.outer(x, u)),
+        q=-u,
+        C=-(jnp.outer(nu, u) + jnp.outer(wC, x)),
+        l=l_bar,
+        u=u_bar,
+        lb=lb_bar,
+        ub=ub_bar,
+        var_mask=jnp.zeros_like(qp.var_mask),
+        row_mask=jnp.zeros_like(qp.row_mask),
+        constant=jnp.zeros_like(qp.constant),
+        Pf=None if qp.Pf is None else jnp.zeros_like(qp.Pf),
+        Pdiag=None if qp.Pdiag is None else jnp.zeros_like(qp.Pdiag),
+    )
+
+
 def _fwd(qp: CanonicalQP, params: SolverParams):
     sol = solve_qp(qp, params)
     return sol.x, (qp, sol)
@@ -148,38 +182,102 @@ def _bwd(params: SolverParams, res, g):
     aC, _, aB, _, up_side_C, up_side_B = active_sets(qp, sol)
     u, wC, wB = _adjoint_kkt_solve(qp, params, aC, aB, g)
 
-    x = sol.x
-    nu = aC * sol.y
-    P_bar = -0.5 * (jnp.outer(u, x) + jnp.outer(x, u))
-    q_bar = -u
-    C_bar = -(jnp.outer(nu, u) + jnp.outer(wC, x))
-    # Bound cotangents: +w on the active side (F2 = aC*(Cx - bound) has
-    # d/dbound = -aC, so bound_bar = +wC; likewise box). Equality rows
-    # (l == u) classify as lower-side by convention — their cotangent
-    # lands on l; callers moving an equality bound move both l and u
-    # together, so the total differential is identical.
-    zero_m = jnp.zeros(qp.m, dtype)
     zero_n = jnp.zeros(qp.n, dtype)
-    l_bar = jnp.where(up_side_C, zero_m, wC)
-    u_bar = jnp.where(up_side_C, wC, zero_m)
     lb_bar = jnp.where(up_side_B, zero_n, wB)
     ub_bar = jnp.where(up_side_B, wB, zero_n)
-
-    qp_bar = CanonicalQP(
-        P=P_bar,
-        q=q_bar,
-        C=C_bar,
-        l=l_bar,
-        u=u_bar,
-        lb=lb_bar,
-        ub=ub_bar,
-        var_mask=jnp.zeros_like(qp.var_mask),
-        row_mask=jnp.zeros_like(qp.row_mask),
-        constant=jnp.zeros_like(qp.constant),
-        Pf=None if qp.Pf is None else jnp.zeros_like(qp.Pf),
-        Pdiag=None if qp.Pdiag is None else jnp.zeros_like(qp.Pdiag),
-    )
-    return (qp_bar,)
+    return (_qp_cotangents(qp, sol, u, wC, aC, up_side_C, lb_bar, ub_bar),)
 
 
 solve_qp_diff.defvjp(_fwd, _bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def solve_qp_l1_diff(qp: CanonicalQP, l1_weight, l1_center,
+                     params: SolverParams) -> jax.Array:
+    """Differentiable solve of the NATIVE nonsmooth problem
+
+        min 1/2 x'Px + q'x + sum_i w_i |x_i - c_i|   s.t. rows, box
+
+    (the n-variable prox path, not the reference's 2n lift). Locally
+    the L1 term splits the coordinates: *kink-resters* (x_i = c_i, dual
+    strictly inside [-w_i, w_i]) behave as pinned equalities at c_i,
+    and *smooth-side* coordinates see a constant gradient w_i
+    sign(x_i - c_i) — exactly the classification the prox-aware polish
+    uses (``qp/polish.py``). The vjp therefore adds two cotangents to
+    :func:`solve_qp_diff`'s set:
+
+        w_bar_i = -u_i sign_i   (smooth live coordinates; a
+                                 kink-rester's solution is locally
+                                 independent of its weight)
+        c_bar_i = +wB_i         (kink-resters, via their pin row;
+                                 smooth coordinates see c only through
+                                 the locally-constant sign)
+
+    Differentiability holds under strict complementarity AND strict
+    kink classification (no coordinate exactly at the sign boundary);
+    at a classification change the gradient is one-sided, as for the
+    active sets. ``l1_center`` must lie strictly inside the box for
+    kink-resters (else the pin and a box bound coincide — the box
+    cotangent wins).
+    """
+    return solve_qp(qp, params, l1_weight=l1_weight,
+                    l1_center=l1_center).x
+
+
+def _l1_fwd(qp, l1_weight, l1_center, params):
+    sol = solve_qp(qp, params, l1_weight=l1_weight, l1_center=l1_center)
+    return sol.x, (qp, l1_weight, l1_center, sol)
+
+
+def _l1_bwd(params, res, g):
+    qp, w, c_in, sol = res
+    dtype = qp.P.dtype
+    # The forward solve treats a missing center as zeros (the polish's
+    # convention); the backward must too — and hand back a None
+    # cotangent for a None input.
+    c = jnp.zeros(qp.n, dtype) if c_in is None else c_in
+    ok = (sol.status == Status.SOLVED).astype(dtype)
+    g = g * ok
+
+    x, mu = sol.x, sol.mu
+    tiny = 1e3 * jnp.asarray(jnp.finfo(dtype).eps, dtype)
+    err = jnp.maximum(sol.prim_res, sol.dual_res)
+    prox = jnp.maximum(tiny, 10.0 * err)
+    # Shared classification with the prox-aware polish: kink set, the
+    # smooth-side signs, and the de-L1'd box dual come from ONE helper
+    # (classify_l1), with `err` the solution's residual scale.
+    at_kink, sign_s, mu_box, window = classify_l1(x, mu, w, c, err,
+                                                  dual_mode="solution")
+    # classify_l1 gates on live = w > 0, which zeroes sub_sign for a
+    # coordinate whose weight IS zero — but the tuning derivative at
+    # w_i = 0 is the one-sided limit -u_i sign(x_i - c_i) (switching on
+    # an infinitesimal penalty pulls x_i toward c_i), which is
+    # generically nonzero. Only a coordinate sitting on the would-be
+    # kink (x_i = c_i) has a genuinely ambiguous (two-sided) limit,
+    # where zero is the defensible subgradient choice.
+    dead_side = jnp.where(jnp.abs(x - c) > window, jnp.sign(x - c), 0.0)
+    sign_s = jnp.where(w > 0, sign_s, dead_side).astype(dtype)
+    (act_low_C, act_up_C, eq_C, act_low_B, act_up_B, eq_B
+     ) = classify_active(qp, sol.z, x, sol.y, mu_box, prox, tiny)
+    aC = ((act_low_C | act_up_C | eq_C) & (qp.row_mask > 0)).astype(dtype)
+    up_side_C = act_up_C & ~act_low_C
+    box_act = (act_low_B | act_up_B | eq_B) & (qp.var_mask > 0)
+    up_side_B = act_up_B & ~act_low_B
+    # A coordinate that is both box-active and on its kink (c on a box
+    # bound) is a genuinely one-sided point; the box cotangent wins, as
+    # the entry-point docstring states.
+    at_kink = at_kink & ~box_act
+
+    aB_all = (box_act | at_kink).astype(dtype)
+    u, wC, wB = _adjoint_kkt_solve(qp, params, aC, aB_all, g)
+
+    zero_n = jnp.zeros(qp.n, dtype)
+    lb_bar = jnp.where(box_act & ~up_side_B, wB, zero_n)
+    ub_bar = jnp.where(box_act & up_side_B, wB, zero_n)
+    c_bar = jnp.where(at_kink, wB, zero_n)
+    w_bar = -u * sign_s
+    qp_bar = _qp_cotangents(qp, sol, u, wC, aC, up_side_C, lb_bar, ub_bar)
+    return (qp_bar, w_bar, None if c_in is None else c_bar)
+
+
+solve_qp_l1_diff.defvjp(_l1_fwd, _l1_bwd)

@@ -132,6 +132,55 @@ def _kkt_solve_factored(qp: CanonicalQP, params: SolverParams,
     return x, aC_eff * nu, tau
 
 
+def classify_l1(x, mu, l1_weight, l1_center, err, dual_mode="iterate"):
+    """Kink-vs-smooth classification of a native-L1 point; shared by
+    the prox-aware polish pass and the differentiable-solve adjoint
+    (``qp/diff.py``) — the two MUST agree or the backward gradient
+    describes a different piece of the piecewise-smooth solution map
+    than the forward polish landed on.
+
+    The point leaves variables that belong ON the kink up to ~its own
+    error away from it, so primal proximity alone cannot decide:
+    candidates within a window that tracks ``err`` (the caller's
+    measure of the point's error — iterate infeasibility in the polish,
+    solution residuals in the adjoint) are classified by the DUAL — at
+    (near-)optimality the combined box dual carries the L1 subgradient,
+    strictly inside [-w, w] exactly for kink-resters, pinned at +/-w
+    for smooth-side variables (whose side ``sign(mu)`` reports more
+    reliably than ``sign(x - c)`` when x sits within error of the
+    kink). Returns ``(at_kink, sub_sign, mu_box_est, window)`` where
+    ``mu_box_est`` is the dual with the L1 subgradient shrunk away (so
+    box-activity tests see only the box part) and ``sub_sign`` the
+    fixed local gradient sign of smooth live variables.
+
+    ``dual_mode`` picks the dual-interior margin. ``"iterate"`` (the
+    polish): duals are noisy, so anything within 0.75 w counts as
+    interior — a wrong guess only costs a rejected pass. ``"solution"``
+    (the differentiable-solve adjoint): duals are converged and there
+    is NO acceptance guard downstream, so the margin must be exact —
+    movers saturate |mu| = w to roundoff while resters can carry
+    subgradients arbitrarily close below it; interior means
+    ``|mu| <= w - max(10 err, sqrt(eps) w)``.
+    """
+    dtype = x.dtype
+    kink_tol = jnp.sqrt(jnp.asarray(jnp.finfo(dtype).eps, dtype))
+    live = l1_weight > 0
+    window = 10.0 * (err + kink_tol)
+    near = live & (jnp.abs(x - l1_center) <= window)
+    if dual_mode == "iterate":
+        dual_interior = jnp.abs(mu) <= 0.75 * l1_weight
+    else:
+        slack = jnp.maximum(10.0 * err, kink_tol * l1_weight)
+        dual_interior = jnp.abs(mu) <= l1_weight - slack
+    at_kink = near & dual_interior
+    sub_sign = jnp.where(
+        live & ~at_kink,
+        jnp.where(near, jnp.sign(mu), jnp.sign(x - l1_center)),
+        0.0).astype(dtype)
+    mu_box_est = mu - jnp.clip(mu, -l1_weight, l1_weight)
+    return at_kink, sub_sign, mu_box_est, window
+
+
 def classify_active(qp: CanonicalQP, zC, xB, y, mu, prox_tol, dual_tol):
     """Shared active-set classification: dual sign (OSQP's criterion)
     with an on-(finite-)bound proximity fallback, equality rows/boxes
@@ -299,34 +348,13 @@ def _polish_pass(qp: CanonicalQP,
 
     has_l1 = l1_weight is not None
     if has_l1:
-        # Kink-vs-smooth classification. The iterate leaves variables
-        # that belong ON the kink up to ~its own infeasibility away from
-        # it, so primal proximity alone cannot decide: candidates within
-        # a window that tracks the iterate's error are classified by the
-        # DUAL — at (near-)optimality the combined box dual carries the
-        # L1 subgradient, strictly inside [-w, w] exactly for
-        # kink-resters, pinned at +/-w for smooth-side variables (whose
-        # side sign(mu) reports more reliably than sign(x - c) when x
-        # sits within iterate error of the kink). Misclassifications are
-        # still caught by the acceptance guards below, and repeated
-        # passes (solve.py) shrink the window as the point converges.
-        kink_tol = jnp.sqrt(jnp.asarray(jnp.finfo(dtype).eps, dtype))
         l1c = jnp.zeros(n, dtype) if l1_center is None else l1_center
-        live = l1_weight > 0
-        window = 10.0 * (prox_err + kink_tol)
-        near = live & (jnp.abs(x - l1c) <= window)
-        dual_interior = jnp.abs(mu) <= 0.75 * l1_weight
-        at_kink = near & dual_interior
-        near_smooth_sign = jnp.sign(mu)
-        far_sign = jnp.sign(x - l1c)
-        sub_sign = jnp.where(
-            live & ~at_kink, jnp.where(near, near_smooth_sign, far_sign), 0.0)
+        at_kink, sub_sign, mu_box_est, window = classify_l1(
+            x, mu, l1_weight, l1c, prox_err)
         q_eff = qp.q + l1_weight * sub_sign
-        # The combined dual mu carries the L1 subgradient (magnitude up
-        # to w_i); shrink it away so box-activity tests see only the
-        # box-dual part — otherwise every live-L1 variable looks
-        # box-active the moment w_i exceeds the dual threshold.
-        mu_box_est = mu - jnp.clip(mu, -l1_weight, l1_weight)
+        # Used by the crossing-repair and sanity gates below.
+        kink_tol = jnp.sqrt(jnp.asarray(jnp.finfo(dtype).eps, dtype))
+        live = l1_weight > 0
     else:
         at_kink = jnp.zeros(n, bool)
         sub_sign = jnp.zeros(n, dtype)

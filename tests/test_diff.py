@@ -333,3 +333,316 @@ def test_grad_constraint_matrix_matches_finite_differences():
         cm[i] -= h
         fd = (loss_at(cp) - loss_at(cm)) / (2 * h)
         np.testing.assert_allclose(g[i], fd, rtol=1e-4, atol=1e-8)
+
+
+class TestL1Diff:
+    """Native L1-prox path gradients (solve_qp_l1_diff) vs finite
+    differences: the turnover-penalty knob and the centers (previous
+    holdings) at a solution with BOTH kink-resters and movers."""
+
+    @pytest.fixture(scope="class")
+    def l1_problem(self):
+        from porqua_tpu.qp.diff import solve_qp_l1_diff  # noqa: F401
+
+        rng = np.random.default_rng(31)
+        n, T = 10, 40
+        X = jnp.asarray(rng.standard_normal((T, n)) * 0.1)
+        w_true = rng.dirichlet(np.ones(n))
+        y = X @ jnp.asarray(w_true)
+        # Previous holdings near the optimum: with a mid-sized penalty
+        # some coordinates stay exactly at c (kink-resters), others
+        # move (smooth) — verified in test_classification_is_mixed.
+        c_prev = jnp.asarray(rng.dirichlet(np.ones(n)))
+        lam = 2e-3
+        cvec = jnp.asarray(rng.standard_normal(n))
+        return X, y, c_prev, lam, cvec
+
+    def _build(self, X, y):
+        n = X.shape[1]
+        dtype = X.dtype
+        return CanonicalQP(
+            P=2.0 * X.T @ X + 0.01 * jnp.eye(n, dtype=dtype),
+            q=-2.0 * X.T @ y,
+            C=jnp.ones((1, n), dtype), l=jnp.ones(1, dtype),
+            u=jnp.ones(1, dtype),
+            lb=jnp.zeros(n, dtype), ub=jnp.ones(n, dtype),
+            var_mask=jnp.ones(n, dtype), row_mask=jnp.ones(1, dtype),
+            constant=jnp.dot(y, y),
+        )
+
+    def test_classification_is_mixed(self, l1_problem):
+        X, y, c_prev, lam, _ = l1_problem
+        n = X.shape[1]
+        sol = solve_qp(self._build(X, y), PARAMS,
+                       l1_weight=jnp.full(n, lam), l1_center=c_prev)
+        assert bool(sol.status == Status.SOLVED)
+        at_c = np.abs(np.asarray(sol.x) - np.asarray(c_prev)) < 1e-9
+        assert 0 < int(at_c.sum()) < n, at_c
+
+    def test_grad_l1_weight_matches_fd(self, l1_problem):
+        from porqua_tpu.qp.diff import solve_qp_l1_diff
+
+        X, y, c_prev, lam, cvec = l1_problem
+        n = X.shape[1]
+        qp0 = self._build(X, y)
+
+        def loss_jax(lam_s):
+            return jnp.dot(cvec, solve_qp_l1_diff(
+                qp0, jnp.full(n, lam_s), c_prev, PARAMS))
+
+        g = float(jax.grad(loss_jax)(jnp.asarray(lam, jnp.float64)))
+
+        h = 1e-7
+
+        def loss_at(ls):
+            return float(jnp.dot(cvec, solve_qp(
+                qp0, PARAMS, l1_weight=jnp.full(n, ls),
+                l1_center=c_prev).x))
+
+        g_fd = (loss_at(lam + h) - loss_at(lam - h)) / (2 * h)
+        np.testing.assert_allclose(g, g_fd, rtol=1e-3, atol=1e-8)
+
+    def test_grad_l1_center_matches_fd(self, l1_problem):
+        from porqua_tpu.qp.diff import solve_qp_l1_diff
+
+        X, y, c_prev, lam, cvec = l1_problem
+        n = X.shape[1]
+        qp0 = self._build(X, y)
+        lamv = jnp.full(n, lam)
+
+        def loss_jax(cv):
+            return jnp.dot(cvec, solve_qp_l1_diff(qp0, lamv, cv, PARAMS))
+
+        g = np.asarray(jax.grad(loss_jax)(c_prev))
+
+        sol = solve_qp(qp0, PARAMS, l1_weight=lamv, l1_center=c_prev)
+        at_c = np.abs(np.asarray(sol.x) - np.asarray(c_prev)) < 1e-9
+        h = 1e-7
+        c_np = np.asarray(c_prev)
+
+        def loss_at(cv):
+            return float(jnp.dot(cvec, solve_qp(
+                qp0, PARAMS, l1_weight=lamv,
+                l1_center=jnp.asarray(cv)).x))
+
+        # Check one kink-rester (nonzero grad: moving its anchor moves
+        # the pinned weight) and one mover (zero grad locally).
+        i_kink = int(np.argmax(at_c))
+        i_move = int(np.argmax(~at_c))
+        for i in (i_kink, i_move):
+            cp, cm = c_np.copy(), c_np.copy()
+            cp[i] += h
+            cm[i] -= h
+            fd = (loss_at(cp) - loss_at(cm)) / (2 * h)
+            np.testing.assert_allclose(g[i], fd, rtol=1e-3, atol=1e-7)
+        assert abs(g[i_move]) < 1e-7
+
+    def test_grad_q_matches_fd_with_l1(self, l1_problem):
+        from porqua_tpu.qp.diff import solve_qp_l1_diff
+
+        X, y, c_prev, lam, cvec = l1_problem
+        n = X.shape[1]
+        qp0 = self._build(X, y)
+        lamv = jnp.full(n, lam)
+
+        def loss_jax(q):
+            return jnp.dot(cvec, solve_qp_l1_diff(
+                qp0._replace(q=q), lamv, c_prev, PARAMS))
+
+        g = np.asarray(jax.grad(loss_jax)(qp0.q))
+
+        h = 1e-7
+        q_np = np.asarray(qp0.q)
+
+        def loss_at(qv):
+            return float(jnp.dot(cvec, solve_qp(
+                qp0._replace(q=jnp.asarray(qv)), PARAMS,
+                l1_weight=lamv, l1_center=c_prev).x))
+
+        for i in [0, 4, 9]:
+            qp_, qm_ = q_np.copy(), q_np.copy()
+            qp_[i] += h
+            qm_[i] -= h
+            fd = (loss_at(qp_) - loss_at(qm_)) / (2 * h)
+            np.testing.assert_allclose(g[i], fd, rtol=1e-3, atol=1e-7)
+
+
+def test_l1_center_on_box_bound_routes_cotangent_to_box():
+    """The natural turnover corner: previous holding 0 for an asset
+    whose weight stays 0 — the kink pin and the lb coincide. Per the
+    documented precedence the BOX cotangent wins: lb gets the
+    sensitivity, the center gets zero."""
+    from porqua_tpu.qp.diff import solve_qp_l1_diff
+
+    rng = np.random.default_rng(41)
+    n, T = 8, 30
+    X = jnp.asarray(rng.standard_normal((T, n)) * 0.1)
+    w_true = np.zeros(n)
+    w_true[: n - 2] = rng.dirichlet(np.ones(n - 2))  # last 2 assets dead
+    y = X @ jnp.asarray(w_true)
+    c_prev = jnp.asarray(np.concatenate(
+        [rng.dirichlet(np.ones(n - 2)), [0.0, 0.0]]))
+    lam = jnp.full(n, 5e-3, jnp.float64)
+    dtype = X.dtype
+    qp0 = CanonicalQP(
+        P=2.0 * X.T @ X + 0.01 * jnp.eye(n, dtype=dtype),
+        q=-2.0 * X.T @ y,
+        C=jnp.ones((1, n), dtype), l=jnp.ones(1, dtype),
+        u=jnp.ones(1, dtype),
+        lb=jnp.zeros(n, dtype), ub=jnp.ones(n, dtype),
+        var_mask=jnp.ones(n, dtype), row_mask=jnp.ones(1, dtype),
+        constant=jnp.dot(y, y),
+    )
+    sol = solve_qp(qp0, PARAMS, l1_weight=lam, l1_center=c_prev)
+    assert bool(sol.status == Status.SOLVED)
+    dead = np.where(np.asarray(sol.x) < 1e-9)[0]
+    assert dead.size > 0, np.asarray(sol.x)
+    i = int(dead[0])
+    assert float(c_prev[i]) == 0.0  # pin and lb coincide at this corner
+
+    cvec = jnp.asarray(rng.standard_normal(n))
+
+    def loss_c(cv):
+        return jnp.dot(cvec, solve_qp_l1_diff(qp0, lam, cv, PARAMS))
+
+    def loss_lb(lb):
+        return jnp.dot(cvec, solve_qp_l1_diff(
+            qp0._replace(lb=lb), lam, c_prev, PARAMS))
+
+    g_c = np.asarray(jax.grad(loss_c)(c_prev))
+    g_lb = np.asarray(jax.grad(loss_lb)(qp0.lb))
+    assert abs(g_c[i]) < 1e-10, g_c[i]
+    # One-sided FD upward (moving lb up drags the pinned weight with
+    # it) must match the reported lb gradient.
+    h = 1e-7
+    lb_p = np.zeros(n)
+    lb_p[i] = h
+    up = float(jnp.dot(cvec, solve_qp(
+        qp0._replace(lb=jnp.asarray(lb_p)), PARAMS,
+        l1_weight=lam, l1_center=c_prev).x))
+    base = float(jnp.dot(cvec, sol.x))
+    np.testing.assert_allclose(g_lb[i], (up - base) / h, rtol=1e-3,
+                               atol=1e-7)
+
+
+def test_l1_grad_with_near_saturated_rester_subgradients():
+    """Regression: kink-resters can carry subgradients arbitrarily
+    close below w (here up to 0.91 w) while movers saturate |mu| = w
+    exactly — the polish's noisy-iterate 0.75 w margin misclassified
+    them in the adjoint and produced gradients wrong by sign. The
+    solution-mode margin (classify_l1 dual_mode="solution") must match
+    finite differences on exactly that problem."""
+    from porqua_tpu.qp.diff import solve_qp_l1_diff
+
+    rng = np.random.default_rng(11)
+    N, T = 16, 40
+    w_prev = jnp.asarray(rng.dirichlet(np.ones(N)))
+    w_true = rng.dirichlet(np.ones(N))
+    Xs = rng.standard_normal((3, 2 * T, N)) * 0.01
+    ys = Xs @ w_true + rng.standard_normal((3, 2 * T)) * 0.002
+    X, y = jnp.asarray(Xs[2, :T]), jnp.asarray(ys[2, :T])
+    lam = 10.0 ** -3.2
+    dtype = X.dtype
+    qp0 = CanonicalQP(
+        P=2.0 * X.T @ X, q=-2.0 * X.T @ y,
+        C=jnp.ones((1, N), dtype), l=jnp.ones(1, dtype),
+        u=jnp.ones(1, dtype),
+        lb=jnp.zeros(N, dtype), ub=jnp.ones(N, dtype),
+        var_mask=jnp.ones(N, dtype), row_mask=jnp.ones(1, dtype),
+        constant=jnp.dot(y, y),
+    )
+    sol = solve_qp(qp0, PARAMS, l1_weight=jnp.full(N, lam),
+                   l1_center=w_prev)
+    mu_over_lam = np.abs(np.asarray(sol.mu)) / lam
+    at_c = np.abs(np.asarray(sol.x) - np.asarray(w_prev)) < 1e-9
+    # Preflight: the fixture must contain the failure regime.
+    assert float(mu_over_lam[at_c].max()) > 0.8, mu_over_lam[at_c]
+
+    cvec = jnp.asarray(rng.standard_normal(N))
+
+    def loss_jax(lam_s):
+        return jnp.dot(cvec, solve_qp_l1_diff(
+            qp0, jnp.full(N, lam_s), w_prev, PARAMS))
+
+    g = float(jax.grad(loss_jax)(jnp.asarray(lam, jnp.float64)))
+    h = 1e-8
+
+    def loss_at(ls):
+        return float(jnp.dot(cvec, solve_qp(
+            qp0, PARAMS, l1_weight=jnp.full(N, ls),
+            l1_center=w_prev).x))
+
+    fd = (loss_at(lam + h) - loss_at(lam - h)) / (2 * h)
+    np.testing.assert_allclose(g, fd, rtol=1e-4, atol=1e-9)
+
+
+def test_l1_weight_zero_has_one_sided_gradient():
+    """d(loss)/d(w_i) at w_i = 0 is the one-sided limit
+    -u_i sign(x_i - c_i), not a dead zero: a tuning loop starting at
+    zero penalty must receive a pull."""
+    from porqua_tpu.qp.diff import solve_qp_l1_diff
+
+    rng = np.random.default_rng(31)
+    n, T = 10, 40
+    X = jnp.asarray(rng.standard_normal((T, n)) * 0.1)
+    w_true = rng.dirichlet(np.ones(n))
+    y = X @ jnp.asarray(w_true)
+    c_prev = jnp.asarray(rng.dirichlet(np.ones(n)))
+    cvec = jnp.asarray(rng.standard_normal(n))
+    dtype = X.dtype
+    qp0 = CanonicalQP(
+        P=2.0 * X.T @ X + 0.01 * jnp.eye(n, dtype=dtype),
+        q=-2.0 * X.T @ y,
+        C=jnp.ones((1, n), dtype), l=jnp.ones(1, dtype),
+        u=jnp.ones(1, dtype),
+        lb=jnp.zeros(n, dtype), ub=jnp.ones(n, dtype),
+        var_mask=jnp.ones(n, dtype), row_mask=jnp.ones(1, dtype),
+        constant=jnp.dot(y, y),
+    )
+
+    def loss_jax(lam_s):
+        return jnp.dot(cvec, solve_qp_l1_diff(
+            qp0, jnp.full(n, lam_s), c_prev, PARAMS))
+
+    g = float(jax.grad(loss_jax)(jnp.asarray(0.0, jnp.float64)))
+    h = 1e-7
+    fd_right = (float(loss_jax(jnp.asarray(h))) -
+                float(loss_jax(jnp.asarray(0.0)))) / h
+    assert abs(g) > 1e-3, g
+    np.testing.assert_allclose(g, fd_right, rtol=1e-3)
+
+
+def test_l1_center_none_is_differentiable():
+    """l1_center=None (centered at zero, the polish convention) must
+    work under jax.grad, with gradients matching an explicit zero
+    center."""
+    from porqua_tpu.qp.diff import solve_qp_l1_diff
+
+    rng = np.random.default_rng(13)
+    n, T = 8, 24
+    X = jnp.asarray(rng.standard_normal((T, n)) * 0.1)
+    y = X @ jnp.asarray(rng.dirichlet(np.ones(n)))
+    cvec = jnp.asarray(rng.standard_normal(n))
+    dtype = X.dtype
+    qp0 = CanonicalQP(
+        P=2.0 * X.T @ X + 0.01 * jnp.eye(n, dtype=dtype),
+        q=-2.0 * X.T @ y,
+        C=jnp.ones((1, n), dtype), l=jnp.ones(1, dtype),
+        u=jnp.ones(1, dtype),
+        lb=jnp.full(n, -1.0, dtype), ub=jnp.ones(n, dtype),
+        var_mask=jnp.ones(n, dtype), row_mask=jnp.ones(1, dtype),
+        constant=jnp.dot(y, y),
+    )
+    lam = 1e-3
+
+    def loss_none(lam_s):
+        return jnp.dot(cvec, solve_qp_l1_diff(
+            qp0, jnp.full(n, lam_s), None, PARAMS))
+
+    def loss_zero(lam_s):
+        return jnp.dot(cvec, solve_qp_l1_diff(
+            qp0, jnp.full(n, lam_s), jnp.zeros(n, jnp.float64), PARAMS))
+
+    g_none = float(jax.grad(loss_none)(jnp.asarray(lam, jnp.float64)))
+    g_zero = float(jax.grad(loss_zero)(jnp.asarray(lam, jnp.float64)))
+    np.testing.assert_allclose(g_none, g_zero, rtol=1e-10)
