@@ -646,3 +646,41 @@ def test_l1_center_none_is_differentiable():
     g_none = float(jax.grad(loss_none)(jnp.asarray(lam, jnp.float64)))
     g_zero = float(jax.grad(loss_zero)(jnp.asarray(lam, jnp.float64)))
     np.testing.assert_allclose(g_none, g_zero, rtol=1e-10)
+
+
+def test_grad_through_turnover_coupled_scan():
+    """The sequential cost-aware backtest: lax.scan chains each date's
+    solution into the next date's L1 center (w_prev). solve_qp_l1_diff
+    composes with scan, so d(total net)/d(lambda) backpropagates
+    through the whole date chain — including the c_bar cotangents that
+    flow BACKWARD across dates. Checked against finite differences of
+    the full chained solve."""
+    from porqua_tpu.qp.diff import solve_qp_l1_diff
+
+    rng = np.random.default_rng(53)
+    n, T, B = 8, 30, 4
+    Xs = jnp.asarray(rng.standard_normal((B, T, n)) * 0.1)
+    w_true = rng.dirichlet(np.ones(n))
+    ys = jnp.einsum("bti,i->bt", Xs, jnp.asarray(w_true))
+    w0 = jnp.asarray(rng.dirichlet(np.ones(n)))
+
+    def chained_net(lam):
+        def body(c_prev, Xy):
+            X, y = Xy
+            x = solve_qp_l1_diff(
+                _build_qp(X, y, ub=1.0, ridge=0.005), jnp.full(n, lam),
+                c_prev, PARAMS)
+            te = jnp.sqrt(jnp.mean((X @ x - y) ** 2))
+            cost = 0.003 * jnp.sum(jnp.abs(x - c_prev))
+            return x, te + cost
+
+        _, nets = jax.lax.scan(body, w0, (Xs, ys))
+        return jnp.sum(nets)
+
+    lam0 = 1.5e-3
+    g = float(jax.grad(chained_net)(jnp.asarray(lam0, jnp.float64)))
+    h = 1e-7
+    fd = (float(chained_net(jnp.asarray(lam0 + h)))
+          - float(chained_net(jnp.asarray(lam0 - h)))) / (2 * h)
+    np.testing.assert_allclose(g, fd, rtol=1e-3, atol=1e-8)
+    assert abs(g) > 1e-6  # the chain is genuinely lambda-sensitive
