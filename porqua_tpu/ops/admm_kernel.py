@@ -11,15 +11,27 @@ factor crosses HBM once per ~25 iterations instead of once per
 iteration. With the batch as the grid axis, Pallas double-buffers the
 next problem's DMA behind the current problem's iteration loop for free.
 
-Status (round-2 measurement): the kernel is **opt-in**
-(``backend="pallas"``), not the default. Applying the KKT operator
-through an explicit f32 inverse carries ``cond(K)*eps`` error, which
-costs extra ADMM segments on ill-conditioned problems (measured 100 vs
-25 iterations on the north-star batch) — more than the HBM savings
-repay. The default path keeps the factor-reuse idea at chol-level
-accuracy by inverting only the *triangular factor* once per segment
-(``SolverParams.linsolve="trinv"``, error ``sqrt(cond(K))*eps``) and
-running the iterations as dense matvecs in stock XLA.
+Status (retired to exemplar after the round-3 on-chip batch): the
+kernel is **opt-in** (``backend="pallas"``), not the default, and has
+no measured regime where it pays on this chip generation. At the
+north-star shape (n=500) both kernel forms time at parity with the
+XLA path (173 vs 176 ms, round 2 — the iteration stage there is
+latency-bound, so the VMEM residency saves nothing XLA's pipelining
+had not already hidden). In its claimed advantage regime (n>=1000,
+where the operator stops fitting cache-adjacent HBM streams) the
+kernel **fails to compile**: ``tpu_compile_helper`` dies with a
+kernel-VMEM-stack OOM at ``vmem_limit_mb=64`` for both the trinv and
+explicit-inverse forms (round-3 measurement log,
+``TPU_MEASURE_r03.txt``), while the XLA trinv path runs the same
+shapes fine. The conditioning concern that motivated the original
+rejection (explicit f32 inverse, ``cond(K)*eps`` error, 100 vs 25
+iterations) was an artifact of the retired x1000 equality-row
+weighting and is fixed — but with no compile at large n and parity at
+small n, the kernel stays an exemplar of the fused-segment technique.
+The production path keeps the factor-reuse idea in stock XLA:
+``linsolve="trinv"`` inverts only the triangular factor once per
+segment, and the round-3 capacitance path (``linsolve="woodbury"``)
+shrinks the factorization itself to the (T+m)-dim dual space.
 
 This replaces the hot loop of the external C solvers the reference
 dispatches to through ``qpsolvers.solve_problem`` (reference
