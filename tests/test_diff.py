@@ -684,3 +684,32 @@ def test_grad_through_turnover_coupled_scan():
           - float(chained_net(jnp.asarray(lam0 - h)))) / (2 * h)
     np.testing.assert_allclose(g, fd, rtol=1e-3, atol=1e-8)
     assert abs(g) > 1e-6  # the chain is genuinely lambda-sensitive
+
+
+def test_grad_f32_agrees_with_f64_direction():
+    """The TPU dtype contract: f32 gradients through the solve are
+    noisier (sqrt(f32-eps) adjoint regularization, looser solve) but
+    must agree with the f64 gradient in direction and to ~10% in
+    magnitude on a well-conditioned problem — good enough for the
+    tuning loops they feed."""
+    rng = np.random.default_rng(3)
+    n, T = 8, 24
+    X64 = jnp.asarray(rng.standard_normal((T, n)) * 0.1, jnp.float64)
+    y64 = X64 @ jnp.asarray(rng.dirichlet(np.ones(n)), jnp.float64)
+    c = rng.standard_normal(n)
+
+    def grad_at(dtype, params):
+        X, y = X64.astype(dtype), y64.astype(dtype)
+        cv = jnp.asarray(c, dtype)
+
+        def loss(ridge):
+            return jnp.dot(cv, solve_qp_diff(
+                _build_qp(X, y, ub=0.4, ridge=ridge), params))
+
+        return float(jax.grad(loss)(jnp.asarray(0.05, dtype)))
+
+    g64 = grad_at(jnp.float64, PARAMS)
+    g32 = grad_at(jnp.float32,
+                  SolverParams(max_iter=20000, eps_abs=1e-6, eps_rel=1e-6))
+    assert np.sign(g64) == np.sign(g32)
+    np.testing.assert_allclose(g32, g64, rtol=0.1)
