@@ -24,23 +24,34 @@ the tunnel black-holed — the 900 s child timeout x 2 attempts + an
   printed with whatever was measured so far.
 * A **cheap TPU probe** (subprocess: ``jax.devices()`` + one tiny
   dispatch, <=90 s) runs before committing to a full child; a hung
-  tunnel costs 90 s, not 900.
-* The full TPU child gets ONE attempt at <=300 s (a healthy run needs
-  ~60-90 s including compile, per the committed hardware log).
+  tunnel costs 90 s, not 900. Round 4: the probe **retries in a loop
+  across the whole deadline** — round 3 burned its one probe on a
+  90 s timeout and never looked again, but the tunnel flaps (the
+  committed session logs show windows opening mid-round).
+* Round 4: every child runs with a **persistent XLA compilation
+  cache** (``.xla_cache/`` next to this file), so a TPU child landing
+  late in the deadline — or the driver's run after a builder-session
+  rehearsal — compiles from disk in seconds instead of ~60-90 s.
 * The CPU fallback runs at a **reduced, pre-validated size**
   (PORQUA_BENCH_FALLBACK_DATES, default 32 — full-size XLA-CPU compile
   alone takes minutes on this 1-core host) and is labeled as such in
   the JSON; its speedup is computed per-date against the same-date-count
-  slice of the serial baseline.
+  slice of the serial baseline. Round 4: the fallback child launches
+  **concurrently at the start** (probing is network-idle; the fallback
+  is host-CPU work), so a dead tunnel no longer serializes
+  probe-wait + fallback and the fallback result is banked early.
 * The child prints its main metric as a marker line BEFORE attempting
   secondary metrics, and the parent parses marker lines out of partial
   output even when the child times out — a death during secondary work
   cannot lose the headline number.
 
-Secondary metrics (BASELINE.json configs 4 and 5, TPU only, each gated
-on the child's remaining budget): the turnover-cost backtest via the
-native L1 prox (``solve_scan_l1``) and the multi-benchmark grid as one
+Secondary metrics (BASELINE.json configs 4 and 5, each gated on the
+child's remaining budget): the turnover-cost backtest via the native
+L1 prox (``solve_scan_l1``) and the multi-benchmark grid as one
 batched program. Both are measured at reduced date counts and labeled.
+Round 4: the CPU fallback emits them too (smaller still — 8 chained
+dates / a 6x21 grid), so the official artifact carries config-4/5
+numbers even when the tunnel is down all round (round-3 verdict item).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} (plus
 diagnostic fields) where value = device wall-clock seconds for the full
@@ -56,6 +67,7 @@ import os
 import signal
 import subprocess
 import sys
+import tempfile
 import time
 
 import numpy as np
@@ -241,6 +253,23 @@ def _resolved_linsolve(params, Xs, ys) -> str:
     return resolve_linsolve(params, qp_shape)
 
 
+def _enable_compile_cache() -> None:
+    """Persistent XLA compilation cache shared by every child AND the
+    driver's own end-of-round run (same directory, same HLO keys): a
+    rehearsed program compiles from disk in seconds. Best-effort — a
+    jax without these flags just compiles from scratch."""
+    try:
+        import jax
+
+        cache = os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), ".xla_cache")
+        os.makedirs(cache, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+    except Exception as e:  # pragma: no cover - jax-version dependent
+        log(f"compile cache unavailable: {e}")
+
+
 def probe_child(platform: str) -> None:
     """Minimal liveness check: init the backend, run one tiny dispatch,
     print a marker line. Bounded by the parent's probe timeout — a hung
@@ -284,6 +313,13 @@ def device_child(platform: str, n_dates: int) -> None:
     def child_left():
         return child_budget - (time.monotonic() - child_start)
 
+    if platform == "tpu":
+        # TPU only: a warm cache turns the ~60-90 s compile into
+        # seconds. The XLA-CPU AOT cache is NOT worth its risk — cached
+        # entries re-load with a machine-feature-mismatch warning
+        # ("could lead to SIGILL", observed in the round-4 rehearsal)
+        # and the fallback program compiles in single-digit seconds.
+        _enable_compile_cache()
     import jax
 
     if platform != "tpu":
@@ -448,6 +484,23 @@ def device_child(platform: str, n_dates: int) -> None:
     })
 
     if dev.platform != "tpu":
+        # Round-4 (verdict item 6): the fallback artifact must still
+        # carry configs 4/5 — smaller sizes again (8 chained dates, a
+        # 6x21 grid; full-size XLA-CPU compiles take minutes on this
+        # 1-core host), labeled by their own n_dates fields.
+        try:
+            if child_left() > 45:
+                _secondary_config4(params, child_left, Xs_np, ys_np,
+                                   n_dates=8)
+            else:
+                log(f"skipping cpu config 4 ({child_left():.0f}s left)")
+            if child_left() > 45:
+                _secondary_config5(params, child_left, n_bench=6,
+                                   n_dates=21, n_assets=24)
+            else:
+                log(f"skipping cpu config 5 ({child_left():.0f}s left)")
+        except Exception as e:  # pragma: no cover - best-effort extras
+            log(f"cpu secondary metrics aborted: {type(e).__name__}: {e}")
         return
 
     # ---- Secondary metrics (BASELINE.json configs 4 and 5) ----------
@@ -659,6 +712,10 @@ def _spawn(args, timeout_s, tag):
         err = f"{tag} timed out after {timeout_s:.0f}s"
     for line in (stderr or "").splitlines():
         log(f"  [{tag}] {line}")
+    return _parse_markers(stdout), err
+
+
+def _parse_markers(stdout: str):
     payloads = []
     for line in (stdout or "").splitlines():
         if line.startswith(_MARKER):
@@ -666,54 +723,124 @@ def _spawn(args, timeout_s, tag):
                 payloads.append(json.loads(line[len(_MARKER):]))
             except json.JSONDecodeError:
                 pass
-    return payloads, err
+    return payloads
+
+
+def _spawn_async(args, tag, budget_s):
+    """Launch a child without waiting (output to temp files — a filled
+    PIPE would block the child). Collect with _collect_async."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # child decides via argv
+    env["PORQUA_BENCH_CHILD_BUDGET"] = str(budget_s)
+    fo = tempfile.TemporaryFile(mode="w+")
+    fe = tempfile.TemporaryFile(mode="w+")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)] + args,
+        stdout=fo, stderr=fe, text=True, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)) or ".")
+    log(f"{tag}: launched in background (budget {budget_s:.0f}s)")
+    return {"proc": proc, "out": fo, "err": fe, "tag": tag,
+            "t0": time.monotonic()}
+
+
+def _collect_async(child, timeout_s):
+    """Wait up to timeout_s for an async child (kill on expiry), then
+    parse whatever marker lines it printed — results are emitted as
+    soon as measured, so a killed child still yields its headline."""
+    tag, err = child["tag"], None
+    try:
+        child["proc"].wait(timeout=max(timeout_s, 0))
+    except subprocess.TimeoutExpired:
+        child["proc"].kill()
+        child["proc"].wait()
+        err = (f"{tag} killed after "
+               f"{time.monotonic() - child['t0']:.0f}s")
+    child["out"].seek(0)
+    child["err"].seek(0)
+    stdout, stderr = child["out"].read(), child["err"].read()
+    if err is None and child["proc"].returncode != 0:
+        tail = stderr[-400:].replace("\n", " | ")
+        err = f"{tag} rc={child['proc'].returncode}: {tail}"
+    for line in stderr.splitlines():
+        log(f"  [{tag}] {line}")
+    return _parse_markers(stdout), err
 
 
 def run_device_benchmark(state):
-    """Probe, then one full TPU attempt, then a reduced CPU fallback —
-    every stage bounded by both its own cap and the global deadline.
+    """Launch the reduced CPU fallback in the background, probe-retry
+    for the TPU across the whole deadline, run the full TPU child the
+    moment a probe lands — every stage clipped to the global deadline.
 
-    Fills state["device"] (main payload), state["secondary"] (list) and
-    appends to state["errors"].
+    Round-4 structure (the round-3 version probed ONCE and spent its
+    remaining budget idling before a serial fallback; the tunnel is
+    known to flap with short windows, so one probe at t=30s against a
+    tunnel that comes up at t=300s recorded nothing):
+
+      t=0   fallback child starts (host CPU work, network-idle probes
+            don't contend for the tunnel)
+      loop  probe (<=90 s each) until success or out of budget
+      hit   TPU child with ALL remaining budget (minus print margin) —
+            with the persistent compile cache a warm child needs ~60 s
+      end   collect the fallback; prefer the TPU result, attach the
+            fallback's wall-clock as a cross-check when both exist
+
+    Fills state["device"] (main payload), state["secondary"] (list),
+    state["fallback_extra"] and appends to state["errors"].
     """
     errors = state["errors"]
     forced = os.environ.get("PORQUA_BENCH_PLATFORM")
 
-    # Reserve: CPU-fallback compile+run at FALLBACK_DATES (validated
-    # ~120 s on this host) + final print margin.
-    FB_RESERVE = 170
+    FINAL_MARGIN = 25      # assemble + print under the SIGALRM
+    MIN_TPU_CHILD = 70     # warm-cache child fits; cold gets headline only
+
+    fb = None
+    if forced != "tpu":
+        if remaining() > 55:
+            fb = _spawn_async(["--device-child", "cpu", str(FALLBACK_DATES)],
+                              "cpu-fallback", min(remaining() - 40, 420))
+        else:
+            errors.append("no time left for the CPU fallback")
 
     tpu_ok = False
     if forced == "cpu":
         log("PORQUA_BENCH_PLATFORM=cpu: skipping TPU")
-    elif remaining() < PROBE_TIMEOUT + 30:
-        errors.append("no time left for a TPU probe")
     else:
-        t0 = time.monotonic()
-        payloads, err = _spawn(
-            ["--probe", "tpu"], min(PROBE_TIMEOUT, remaining() - 20),
-            "tpu-probe")
-        probe = next((p for p in payloads if p.get("part") == "probe"), None)
-        if probe is None:
-            errors.append(err or "tpu probe produced no result")
-            log(f"TPU probe failed in {time.monotonic()-t0:.0f}s — "
-                "skipping the full TPU attempt")
-        elif probe.get("platform") != "tpu":
-            errors.append("default backend resolved to "
-                          f"{probe.get('platform')} (no TPU plugin present)")
-            log("TPU probe came back on a non-TPU backend")
-        else:
-            log(f"TPU probe OK in {time.monotonic()-t0:.0f}s "
-                f"({probe.get('device_kind')})")
-            tpu_ok = True
+        n_probes, wrong_backend = 0, False
+        while remaining() > MIN_TPU_CHILD + FINAL_MARGIN + 10:
+            n_probes += 1
+            t0 = time.monotonic()
+            timeout = min(PROBE_TIMEOUT,
+                          remaining() - MIN_TPU_CHILD - FINAL_MARGIN)
+            payloads, err = _spawn(["--probe", "tpu"], timeout,
+                                   f"tpu-probe-{n_probes}")
+            probe = next((p for p in payloads if p.get("part") == "probe"),
+                         None)
+            took = time.monotonic() - t0
+            if probe is not None and probe.get("platform") == "tpu":
+                log(f"TPU probe {n_probes} OK in {took:.0f}s "
+                    f"({probe.get('device_kind')})")
+                tpu_ok = True
+                break
+            if probe is not None:
+                # A live backend that isn't a TPU won't become one.
+                errors.append("default backend resolved to "
+                              f"{probe.get('platform')} (no TPU plugin)")
+                wrong_backend = True
+                break
+            log(f"TPU probe {n_probes} failed in {took:.0f}s "
+                f"({remaining():.0f}s left) — retrying")
+            if took < 20:  # fast failure: don't spin the host
+                time.sleep(min(20.0, max(remaining() - MIN_TPU_CHILD
+                                         - FINAL_MARGIN - 10, 0)))
+        if not tpu_ok and not wrong_backend:
+            errors.append(
+                f"tpu unreachable across {n_probes} probes over the "
+                f"{DEADLINE_S}s deadline" if n_probes
+                else "no time left for a TPU probe")
 
     if tpu_ok or forced == "tpu":
-        # Always keep a margin under the global SIGALRM: if the alarm
-        # fired mid-communicate, marker lines the child already printed
-        # would be discarded with the exception.
-        budget = min(CHILD_TIMEOUT,
-                     remaining() - (20 if forced else FB_RESERVE))
-        if budget > 60:
+        budget = min(CHILD_TIMEOUT, remaining() - FINAL_MARGIN)
+        if budget > 45:
             payloads, err = _spawn(
                 ["--device-child", "tpu", str(N_DATES)], budget, "tpu")
             main_p = next((p for p in payloads if p.get("part") == "main"),
@@ -725,36 +852,41 @@ def run_device_benchmark(state):
                 if err:
                     # Timeout during secondary metrics: headline intact.
                     errors.append(err)
-                return
-            errors.append(err or "tpu child produced no result line")
+            else:
+                errors.append(err or "tpu child produced no result line")
         else:
             errors.append(f"no budget for a TPU child ({budget:.0f}s)")
 
-    if forced == "tpu":
-        return  # explicit TPU-only run: report the failure, no fallback
+    if fb is None:
+        return  # forced tpu-only run: report the failure, no fallback
 
-    # CPU fallback at reduced, pre-validated size.
-    budget = min(remaining() - 25, 420)
-    if budget < 60:
-        errors.append("no time left for the CPU fallback")
-        return
-    payloads, err = _spawn(
-        ["--device-child", "cpu", str(FALLBACK_DATES)], budget, "cpu-fallback")
+    # Collect the background fallback. Even when the TPU headline
+    # landed, wait it out against the remaining deadline — the deadline
+    # is the bound the driver sees either way, and the cross-platform
+    # cross-check is the point of having run it.
+    payloads, err = _collect_async(fb, remaining() - 15)
     main_p = next((p for p in payloads if p.get("part") == "main"), None)
-    if main_p is not None:
-        state["device"] = main_p
-        # Annotate only a measurement that actually happened; a forced
-        # cpu run is a healthy smoke run, not an error — route it to
-        # the non-error note field.
-        if forced == "cpu":
-            state["note"] = "platform forced to cpu; measured at reduced size"
-        else:
-            errors.insert(
-                0, "tpu unavailable, measured on XLA-CPU at reduced size")
     if err:
-        # Recorded even alongside a successful headline (a child that
-        # printed its result then died still warrants a diagnostic).
+        # Recorded even alongside a successful TPU headline (a child
+        # that printed its result then died warrants a diagnostic).
         errors.append(err)
+    if state["device"] is None:
+        if main_p is not None:
+            state["device"] = main_p
+            state["secondary"] = [p for p in payloads
+                                  if p.get("part", "").startswith("config")]
+            if forced == "cpu":
+                state["note"] = ("platform forced to cpu; measured at "
+                                 "reduced size")
+            else:
+                errors.insert(
+                    0, "tpu unavailable, measured on XLA-CPU at reduced size")
+    elif main_p is not None:
+        # Both measured: keep the TPU headline, record the fallback's
+        # wall-clock as a cross-platform cross-check.
+        state["fallback_extra"] = {
+            "seconds": main_p["seconds"], "n_dates": main_p["n_dates"],
+            "median_te": main_p["median_te"]}
 
 
 class DeadlineReached(Exception):
@@ -846,6 +978,10 @@ def _assemble(state) -> dict:
     for sec in state.get("secondary", []):
         part = sec.pop("part", "secondary")
         payload[part] = sec
+    if state.get("fallback_extra"):
+        # TPU headline landed AND the background CPU fallback finished:
+        # keep both on the record (cross-platform cross-check).
+        payload["cpu_fallback"] = state["fallback_extra"]
     if state.get("turnover_cpu_per_date") is not None:
         c4 = payload.get("config4_turnover")
         per = state["turnover_cpu_per_date"]
@@ -873,7 +1009,8 @@ def main():
         return
 
     state = {"errors": [], "baseline": None, "device": None,
-             "secondary": [], "turnover_cpu_per_date": None, "note": None}
+             "secondary": [], "turnover_cpu_per_date": None, "note": None,
+             "fallback_extra": None}
 
     def on_alarm(signum, frame):
         raise DeadlineReached()

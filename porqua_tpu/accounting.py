@@ -245,9 +245,16 @@ def performance_summary(returns: pd.Series,
         return out
     mean_d, std_d = float(r.mean()), float(r.std())
     levels = (1.0 + r).cumprod()
+    final = float(levels.iloc[-1])
     out = {
         "n_days": int(r.size),
-        "annual_return": float((1.0 + mean_d) ** ann - 1.0),
+        # CAGR from the compounded level path (the quantstats
+        # convention; round-3 advisor finding): consistent with
+        # cumulative_return by construction, where compounding the
+        # arithmetic daily mean overstates growth for volatile series.
+        # A wiped-out path (level <= 0) annualizes to -100%.
+        "annual_return": (float(final ** (ann / r.size) - 1.0)
+                          if final > 0 else -1.0),
         "annual_volatility": std_d * float(np.sqrt(ann)),
         # A zero/undefined-variance series has no defined risk-adjusted
         # return; NaN, never +inf for a flat losing strategy.

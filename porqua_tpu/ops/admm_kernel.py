@@ -11,24 +11,35 @@ factor crosses HBM once per ~25 iterations instead of once per
 iteration. With the batch as the grid axis, Pallas double-buffers the
 next problem's DMA behind the current problem's iteration loop for free.
 
-Status (retired to exemplar after the round-3 on-chip batch): the
-kernel is **opt-in** (``backend="pallas"``), not the default, and has
-no measured regime where it pays on this chip generation. At the
-north-star shape (n=500) both kernel forms time at parity with the
-XLA path (173 vs 176 ms, round 2 — the iteration stage there is
-latency-bound, so the VMEM residency saves nothing XLA's pipelining
-had not already hidden). In its claimed advantage regime (n>=1000,
-where the operator stops fitting cache-adjacent HBM streams) the
-kernel **fails to compile**: ``tpu_compile_helper`` dies with a
-kernel-VMEM-stack OOM at ``vmem_limit_mb=64`` for both the trinv and
-explicit-inverse forms (round-3 measurement log,
-``TPU_MEASURE_r03.txt``), while the XLA trinv path runs the same
-shapes fine. The conditioning concern that motivated the original
-rejection (explicit f32 inverse, ``cond(K)*eps`` error, 100 vs 25
-iterations) was an artifact of the retired x1000 equality-row
-weighting and is fixed — but with no compile at large n and parity at
-small n, the kernel stays an exemplar of the fused-segment technique.
-The production path keeps the factor-reuse idea in stock XLA:
+Status of the DENSE-operator forms (retired to exemplar after the
+round-3 on-chip batch): opt-in (``backend="pallas"``), no measured
+regime where they pay on this chip generation. At the north-star shape
+(n=500) both dense forms time at parity with the XLA path (173 vs
+176 ms, round 2 — the iteration stage there is latency-bound, so the
+VMEM residency saves nothing XLA's pipelining had not already hidden).
+In their claimed advantage regime (n>=1000, where the operator stops
+fitting cache-adjacent HBM streams) they **fail to compile**:
+``tpu_compile_helper`` dies with a kernel-VMEM-stack OOM at
+``vmem_limit_mb=64`` for both the trinv and explicit-inverse forms
+(round-3 measurement log, ``TPU_MEASURE_r03.txt``) — the n x n
+resident operator is structurally too big for VMEM at large n.
+
+Round 4 adds the **factored segment**
+(:func:`admm_segment_factored`): the resident operator is the
+capacitance pieces ``(inv_d, W, Y0, Ginv)`` of the
+``linsolve="woodbury"`` path — ~((T+m) x n) instead of n x n, ~1 MB
+per north-star problem — so the kernel keeps the fused-segment
+residency win *in the regime the promoted TPU headline config actually
+runs*. The XLA woodbury path re-reads W (0.5 MB/problem) twice per
+iteration from HBM: at B=252, 35 iterations, that is ~9 GB of traffic
+this kernel replaces with one W read per problem per segment. It also
+scales where the dense kernel OOMed: at n=2000 the resident set is
+~4 MB (vs the dense kernel's ~16 MB + stack). The production default
+is still the XLA path pending on-chip measurement
+(``scripts/tpu_jobs``); parity is pinned in interpret mode by
+``tests/test_pallas_kernel.py``.
+
+The dense production path keeps the factor-reuse idea in stock XLA:
 ``linsolve="trinv"`` inverts only the triangular factor once per
 segment, and the round-3 capacitance path (``linsolve="woodbury"``)
 shrinks the factorization itself to the (T+m)-dim dual space.
@@ -62,6 +73,76 @@ def _round_up(x: int, mult: int) -> int:
     return ((x + mult - 1) // mult) * mult
 
 
+_HP = jax.lax.Precision.HIGHEST
+
+
+def _row_dot_t(v, M, dtype):
+    """``v @ M'`` in row-vector form: contract ``v``'s lane axis with
+    ``M``'s lane axis. precision=HIGHEST throughout this module: the
+    MXU's default f32 handling drops to bf16 passes, far too coarse for
+    ADMM fixed-point iteration (the iterates diverge)."""
+    return jax.lax.dot_general(
+        v, M, (((1,), (1,)), ((), ())),
+        preferred_element_type=dtype, precision=_HP)
+
+
+def _make_iteration(solve_fn, C, q, l, u, lb, ub, rho, rho_b, l1w, l1c,
+                    sigma, alpha, dtype):
+    """One OSQP iteration (rhs build -> ``solve_fn`` -> prox/dual
+    updates), shared by every kernel form so the linear-solve operator
+    is the ONLY thing that can differ between them."""
+    inv_rho = 1.0 / rho
+    inv_rhob = 1.0 / rho_b
+    sig = jnp.asarray(sigma, dtype)
+    al = jnp.asarray(alpha, dtype)
+    one_m_al = jnp.asarray(1.0 - alpha, dtype)
+
+    def one_iteration(carry):
+        x, z, w, y, mu = carry
+        # rhs = sigma x - q + C'(rho z - y) + (rho_b w - mu); row-vector form.
+        rhs = (
+            sig * x - q
+            + jnp.dot(rho * z - y, C, preferred_element_type=dtype,
+                      precision=_HP)
+            + (rho_b * w - mu)
+        )
+        xt = solve_fn(rhs)
+        zt = _row_dot_t(xt, C, dtype)  # zt = C @ xt
+
+        x_new = al * xt + one_m_al * x
+        z_pre = al * zt + one_m_al * z
+        z_new = jnp.clip(z_pre + y * inv_rho, l, u)
+        y_new = y + rho * (z_pre - z_new)
+        w_pre = al * xt + one_m_al * w
+        w_new = l1_box_prox(w_pre + mu * inv_rhob, lb, ub, l1w * inv_rhob, l1c)
+        mu_new = mu + rho_b * (w_pre - w_new)
+        return (x_new, z_new, w_new, y_new, mu_new)
+
+    return one_iteration
+
+
+def _run_segment(one_iteration, n_iters,
+                 x_ref, z_ref, w_ref, y_ref, mu_ref,
+                 x_out, z_out, w_out, y_out, mu_out,
+                 dx_out, dy_out, dmu_out):
+    """Drive ``n_iters`` iterations and write final state + the
+    one-iteration increments the OSQP infeasibility certificates need."""
+    carry0 = (x_ref[:], z_ref[:], w_ref[:], y_ref[:], mu_ref[:])
+    carry = jax.lax.fori_loop(
+        0, n_iters - 1, lambda _, c: one_iteration(c), carry0
+    )
+    x, z, w, y, mu = one_iteration(carry)
+
+    x_out[:] = x
+    z_out[:] = z
+    w_out[:] = w
+    y_out[:] = y
+    mu_out[:] = mu
+    dx_out[:] = x - carry[0]
+    dy_out[:] = y - carry[3]
+    dmu_out[:] = mu - carry[4]
+
+
 def _segment_kernel(Kinv_ref, C_ref, q_ref, l_ref, u_ref, lb_ref, ub_ref,
                     rho_ref, rhob_ref, l1w_ref, l1c_ref,
                     x_ref, z_ref, w_ref, y_ref, mu_ref,
@@ -80,79 +161,72 @@ def _segment_kernel(Kinv_ref, C_ref, q_ref, l_ref, u_ref, lb_ref, ub_ref,
     """
     dtype = x_ref.dtype
     Kinv = Kinv_ref[:]
+
+    if triangular:
+        # Kinv holds L^-1: xt = L^-T (L^-1 rhs). Row-vector form:
+        # u = rhs @ L^-T (contract rhs lanes with L^-1's lanes),
+        # then xt = u @ L^-1.
+        def solve_fn(rhs):
+            u_row = _row_dot_t(rhs, Kinv, dtype)
+            return jnp.dot(u_row, Kinv, preferred_element_type=dtype,
+                           precision=_HP)
+    else:
+        # K is symmetric, so Kinv is too: x~ = rhs @ Kinv == Kinv @ rhs.
+        def solve_fn(rhs):
+            return jnp.dot(rhs, Kinv, preferred_element_type=dtype,
+                           precision=_HP)
+
+    one_iteration = _make_iteration(
+        solve_fn, C_ref[:], q_ref[:], l_ref[:], u_ref[:], lb_ref[:],
+        ub_ref[:], rho_ref[:], rhob_ref[:], l1w_ref[:], l1c_ref[:],
+        sigma, alpha, dtype)
+    _run_segment(one_iteration, n_iters,
+                 x_ref, z_ref, w_ref, y_ref, mu_ref,
+                 x_out, z_out, w_out, y_out, mu_out,
+                 dx_out, dy_out, dmu_out)
+
+
+def _factored_segment_kernel(W_ref, invd_ref, Y0_ref, Ginv_ref,
+                             C_ref, q_ref, l_ref, u_ref, lb_ref, ub_ref,
+                             rho_ref, rhob_ref, l1w_ref, l1c_ref,
+                             x_ref, z_ref, w_ref, y_ref, mu_ref,
+                             x_out, z_out, w_out, y_out, mu_out,
+                             dx_out, dy_out, dmu_out,
+                             *, sigma: float, alpha: float, n_iters: int):
+    """Factored (capacitance/Woodbury) segment: resident state is
+    ``W`` (k x n), ``inv_d`` (n), ``Y0`` (n x m), ``Ginv`` (m x m) —
+    the exact operator pieces of the XLA ``linsolve="woodbury"`` path
+    (``qp/admm.py``: ``factored_solve_pieces`` + the eq-row Schur
+    split), with the raw refine=0 apply:
+
+        x0 = inv_d * rhs - (rhs W') W
+        xt = x0 - (Ginv (C x0)) Y0'
+    """
+    dtype = x_ref.dtype
+    W = W_ref[:]
+    inv_d = invd_ref[:]
+    Y0 = Y0_ref[:]
+    Ginv = Ginv_ref[:]
     C = C_ref[:]
-    q = q_ref[:]
-    l = l_ref[:]
-    u = u_ref[:]
-    lb = lb_ref[:]
-    ub = ub_ref[:]
-    rho = rho_ref[:]
-    rho_b = rhob_ref[:]
-    l1w = l1w_ref[:]
-    l1c = l1c_ref[:]
-    inv_rho = 1.0 / rho
-    inv_rhob = 1.0 / rho_b
-    sig = jnp.asarray(sigma, dtype)
-    al = jnp.asarray(alpha, dtype)
-    one_m_al = jnp.asarray(1.0 - alpha, dtype)
 
-    def one_iteration(carry):
-        x, z, w, y, mu = carry
-        # rhs = sigma x - q + C'(rho z - y) + (rho_b w - mu); row-vector form.
-        # precision=HIGHEST: the MXU's default f32 handling drops to
-        # bf16 passes, which is far too coarse for ADMM fixed-point
-        # iteration (the iterates diverge); force full f32 accumulation.
-        rhs = (
-            sig * x - q
-            + jnp.dot(rho * z - y, C, preferred_element_type=dtype,
-                      precision=jax.lax.Precision.HIGHEST)
-            + (rho_b * w - mu)
-        )
-        if triangular:
-            # Kinv holds L^-1: xt = L^-T (L^-1 rhs). Row-vector form:
-            # u = rhs @ L^-T (contract rhs lanes with L^-1's lanes),
-            # then xt = u @ L^-1.
-            u_row = jax.lax.dot_general(
-                rhs, Kinv, (((1,), (1,)), ((), ())),
-                preferred_element_type=dtype,
-                precision=jax.lax.Precision.HIGHEST,
-            )
-            xt = jnp.dot(u_row, Kinv, preferred_element_type=dtype,
-                         precision=jax.lax.Precision.HIGHEST)
-        else:
-            # K is symmetric, so Kinv is too: x~ = rhs @ Kinv == Kinv @ rhs.
-            xt = jnp.dot(rhs, Kinv, preferred_element_type=dtype,
-                         precision=jax.lax.Precision.HIGHEST)
-        # zt = C @ xt, contracting xt's lane axis with C's column axis.
-        zt = jax.lax.dot_general(
-            xt, C, (((1,), (1,)), ((), ())), preferred_element_type=dtype,
-            precision=jax.lax.Precision.HIGHEST,
-        )
+    def solve_fn(rhs):
+        t = _row_dot_t(rhs, W, dtype)             # (1, k) = rhs @ W'
+        x0 = rhs * inv_d - jnp.dot(
+            t, W, preferred_element_type=dtype, precision=_HP)
+        s = _row_dot_t(x0, C, dtype)              # (1, m) = C @ x0
+        # G is symmetric (diag(1/rho) + C K0^-1 C'), hence so is Ginv:
+        # row-vector application s @ Ginv == (Ginv s)'.
+        v = jnp.dot(s, Ginv, preferred_element_type=dtype, precision=_HP)
+        return x0 - _row_dot_t(v, Y0, dtype)      # x0 - Y0 @ v
 
-        x_new = al * xt + one_m_al * x
-        z_pre = al * zt + one_m_al * z
-        z_new = jnp.clip(z_pre + y * inv_rho, l, u)
-        y_new = y + rho * (z_pre - z_new)
-        w_pre = al * xt + one_m_al * w
-        w_new = l1_box_prox(w_pre + mu * inv_rhob, lb, ub, l1w * inv_rhob, l1c)
-        mu_new = mu + rho_b * (w_pre - w_new)
-        return (x_new, z_new, w_new, y_new, mu_new)
-
-    carry0 = (x_ref[:], z_ref[:], w_ref[:], y_ref[:], mu_ref[:])
-    carry = jax.lax.fori_loop(
-        0, n_iters - 1, lambda _, c: one_iteration(c), carry0
-    )
-    x, z, w, y, mu = one_iteration(carry)
-
-    x_out[:] = x
-    z_out[:] = z
-    w_out[:] = w
-    y_out[:] = y
-    mu_out[:] = mu
-    # One-iteration increments for the OSQP infeasibility certificates.
-    dx_out[:] = x - carry[0]
-    dy_out[:] = y - carry[3]
-    dmu_out[:] = mu - carry[4]
+    one_iteration = _make_iteration(
+        solve_fn, C, q_ref[:], l_ref[:], u_ref[:], lb_ref[:],
+        ub_ref[:], rho_ref[:], rhob_ref[:], l1w_ref[:], l1c_ref[:],
+        sigma, alpha, dtype)
+    _run_segment(one_iteration, n_iters,
+                 x_ref, z_ref, w_ref, y_ref, mu_ref,
+                 x_out, z_out, w_out, y_out, mu_out,
+                 dx_out, dy_out, dmu_out)
 
 
 @functools.partial(
@@ -229,6 +303,101 @@ def admm_segment(Kinv: jax.Array,
         functools.partial(
             _segment_kernel, sigma=sigma, alpha=alpha, n_iters=n_iters,
             triangular=triangular,
+        ),
+        out_shape=(vec_n, vec_m, vec_n, vec_m, vec_n, vec_n, vec_m, vec_n),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * len(args),
+        out_specs=tuple([pl.BlockSpec(memory_space=pltpu.VMEM)] * 8),
+        interpret=interpret,
+    )(*args)
+
+    x_n, z_n, w_n, y_n, mu_n, dx, dy, dmu = out
+    return (
+        x_n[0, :n], z_n[0, :m], w_n[0, :n], y_n[0, :m], mu_n[0, :n],
+        dx[0, :n], dy[0, :m], dmu[0, :n],
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("sigma", "alpha", "n_iters", "interpret"),
+)
+def admm_segment_factored(W: jax.Array,
+                          inv_d: jax.Array,
+                          Y0: jax.Array,
+                          Ginv: jax.Array,
+                          C: jax.Array,
+                          q: jax.Array,
+                          l: jax.Array,
+                          u: jax.Array,
+                          lb: jax.Array,
+                          ub: jax.Array,
+                          rho: jax.Array,
+                          rho_b: jax.Array,
+                          l1w: jax.Array,
+                          l1c: jax.Array,
+                          x: jax.Array,
+                          z: jax.Array,
+                          w: jax.Array,
+                          y: jax.Array,
+                          mu: jax.Array,
+                          *,
+                          sigma: float,
+                          alpha: float,
+                          n_iters: int,
+                          interpret: bool = False) -> Tuple[jax.Array, ...]:
+    """Run ``n_iters`` fused factored-operator ADMM iterations on one
+    problem (capacitance/Woodbury form, refine=0).
+
+    ``W`` (k x n), ``inv_d`` (n), ``Y0`` (n x m), ``Ginv`` (m x m) are
+    the per-segment operator pieces the XLA woodbury path builds
+    (``qp/admm.py:segment``); the build stays in XLA — this kernel
+    fuses only the iteration loop, which is where the HBM traffic is.
+    Batching is ``jax.vmap`` exactly as for :func:`admm_segment`.
+
+    Padding: k, n, m each round up to lane multiples of 128. Padded W
+    rows/cols and Y0 entries are zero, padded ``Ginv`` carries a unit
+    diagonal, padded bounds are ``[0, 0]`` / ``(-inf, inf)`` with unit
+    step sizes — padded variables fix at exactly zero and cannot
+    perturb the real entries (same argument as :func:`admm_segment`).
+    """
+    dtype = x.dtype
+    n = x.shape[-1]
+    m = z.shape[-1]
+    k = W.shape[-2]
+    n_p = _round_up(max(n, 1), 128)
+    m_p = _round_up(max(m, 1), 128)
+    k_p = _round_up(max(k, 1), 128)
+    inf = jnp.asarray(jnp.inf, dtype)
+
+    def pad_vec(v, size, value=0.0):
+        pad = size - v.shape[-1]
+        if pad == 0:
+            return v[None, :]
+        return jnp.concatenate(
+            [v, jnp.full((pad,), value, dtype)], axis=-1
+        )[None, :]
+
+    W_p = jnp.zeros((k_p, n_p), dtype).at[:k, :n].set(W)
+    Y0_p = jnp.zeros((n_p, m_p), dtype).at[:n, :m].set(Y0)
+    Ginv_p = jnp.eye(m_p, dtype=dtype).at[:m, :m].set(Ginv)
+    C_p = jnp.zeros((m_p, n_p), dtype).at[:m, :n].set(C)
+    args = (
+        W_p, pad_vec(inv_d, n_p, 1.0), Y0_p, Ginv_p, C_p,
+        pad_vec(q, n_p),
+        pad_vec(l, m_p, -inf), pad_vec(u, m_p, inf),
+        pad_vec(lb, n_p), pad_vec(ub, n_p),
+        pad_vec(rho, m_p, 1.0), pad_vec(rho_b, n_p, 1.0),
+        pad_vec(l1w, n_p), pad_vec(l1c, n_p),
+        pad_vec(x, n_p), pad_vec(z, m_p), pad_vec(w, n_p),
+        pad_vec(y, m_p), pad_vec(mu, n_p),
+    )
+
+    vec_n = jax.ShapeDtypeStruct((1, n_p), dtype)
+    vec_m = jax.ShapeDtypeStruct((1, m_p), dtype)
+    out = pl.pallas_call(
+        functools.partial(
+            _factored_segment_kernel, sigma=sigma, alpha=alpha,
+            n_iters=n_iters,
         ),
         out_shape=(vec_n, vec_m, vec_n, vec_m, vec_n, vec_n, vec_m, vec_n),
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * len(args),

@@ -317,10 +317,11 @@ def resolve_linsolve(params: SolverParams, qp: CanonicalQP) -> str:
             raise ValueError(
                 "linsolve='woodbury' requires the factored objective "
                 "(qp.Pf with P = 2 Pf'Pf + diag(Pdiag))")
-        if params.backend == "pallas":
+        if params.backend == "pallas" and params.woodbury_refine != 0:
             raise ValueError(
-                "linsolve='woodbury' is not available inside the fused "
-                "Pallas segment; use backend='xla'")
+                "the fused Pallas factored segment implements the raw "
+                "(refine=0) capacitance apply; set woodbury_refine=0 "
+                "or backend='xla'")
         return "woodbury"
     if ls == "auto":
         if jnp.dtype(qp.P.dtype) == jnp.float32:
@@ -334,6 +335,23 @@ def resolve_linsolve(params: SolverParams, qp: CanonicalQP) -> str:
             return "trinv"
         return "trinv" if jax.default_backend() == "tpu" else "chol"
     return ls
+
+
+def factored_solve_pieces(Dv: jax.Array, V: jax.Array):
+    """(inv_d, W) such that ``K^-1 r = inv_d r - W'(W r)`` for
+    ``K = diag(Dv) + V'V`` — the raw Woodbury/capacitance apply. Shared
+    by :func:`factored_spd_solve_operator` (XLA path) and the fused
+    Pallas factored segment (``ops/admm_kernel.py``), which keeps
+    exactly these two arrays VMEM-resident across a whole segment."""
+    dtype = V.dtype
+    k = V.shape[-2]
+    hp = jax.lax.Precision.HIGHEST
+    inv_d = 1.0 / Dv
+    Vd = V * inv_d[None, :]
+    S = jnp.eye(k, dtype=dtype) + jnp.dot(Vd, V.T, precision=hp)
+    Linv = blocked_triangular_inverse(jnp.linalg.cholesky(S))
+    W = jnp.dot(Linv, Vd, precision=hp)
+    return inv_d, W
 
 
 def factored_spd_solve_operator(Dv: jax.Array, V: jax.Array,
@@ -359,14 +377,16 @@ def factored_spd_solve_operator(Dv: jax.Array, V: jax.Array,
     multiplies the error by that same factor, restoring trinv-grade
     accuracy for ~2x the (cheap) per-application cost.
     """
-    dtype = V.dtype
-    k = V.shape[-2]
+    inv_d, W = factored_solve_pieces(Dv, V)
+    return factored_solve_from_pieces(Dv, V, inv_d, W, refine_steps)
+
+
+def factored_solve_from_pieces(Dv, V, inv_d, W, refine_steps: int = 1):
+    """Assemble the Woodbury solve closure from already-built pieces —
+    callers that also need ``(inv_d, W)`` directly (the fused Pallas
+    factored segment) build them once and share, instead of paying the
+    k x k factorization twice per segment."""
     hp = jax.lax.Precision.HIGHEST
-    inv_d = 1.0 / Dv
-    Vd = V * inv_d[None, :]
-    S = jnp.eye(k, dtype=dtype) + jnp.dot(Vd, V.T, precision=hp)
-    Linv = blocked_triangular_inverse(jnp.linalg.cholesky(S))
-    W = jnp.dot(Linv, Vd, precision=hp)
 
     def base(rhs):
         t = jnp.dot(W, rhs, precision=hp)
@@ -501,17 +521,29 @@ def admm_solve(qp: CanonicalQP,
         mu_new = mu + rho_b * (alpha * xt + (1 - alpha) * w - w_new)
         return (x_new, z_new, w_new, y_new, mu_new)
 
-    # Estimated VMEM footprint of the fused segment: the explicit KKT
-    # inverse (n x n), the constraint matrix (m x n), and ~16 working
-    # vectors of length n or m, all resident at once. The kernel pads
-    # both dims up to lane multiples of 128 (ops/admm_kernel.py), so
+    # Estimated VMEM footprint of the fused segment. Dense forms hold
+    # the explicit KKT inverse (n x n) + the constraint matrix (m x n);
+    # the factored (woodbury) form holds the capacitance pieces
+    # W (k x n), Y0 (n x m), Ginv (m x m) instead of any n x n array —
+    # which is exactly why it still fits where the dense kernel OOMs.
+    # Either way ~16 working vectors ride along, and the kernel pads
+    # every dim up to lane multiples of 128 (ops/admm_kernel.py), so
     # the estimate must use the padded sizes.
+    linsolve = resolve_linsolve(params, qp)
     n_pad = ((max(n, 1) + 127) // 128) * 128
     m_pad = ((max(m, 1) + 127) // 128) * 128
-    vmem_bytes = (
-        (n_pad * n_pad + m_pad * n_pad + 16 * (n_pad + m_pad))
-        * jnp.dtype(dtype).itemsize
-    )
+    if linsolve == "woodbury":
+        k_pad = ((max(qp.Pf.shape[-2], 1) + 127) // 128) * 128
+        vmem_bytes = (
+            (k_pad * n_pad + 2 * m_pad * n_pad + m_pad * m_pad
+             + 16 * (n_pad + m_pad + k_pad))
+            * jnp.dtype(dtype).itemsize
+        )
+    else:
+        vmem_bytes = (
+            (n_pad * n_pad + m_pad * n_pad + 16 * (n_pad + m_pad))
+            * jnp.dtype(dtype).itemsize
+        )
     fits_vmem = vmem_bytes <= params.vmem_limit_mb * 2**20
     # The fused kernel is opt-in only. Its trinv mode matches the XLA
     # path's accuracy, but measured wall-clock is at parity on the
@@ -537,7 +569,6 @@ def admm_solve(qp: CanonicalQP,
                 "path); use backend='auto' unless this is a parity test.",
                 stacklevel=2,
             )
-    linsolve = resolve_linsolve(params, qp)
     use_inverse = use_pallas or linsolve in ("inverse", "trinv", "woodbury")
 
     # Every explicit-inverse linear solve — the Pallas kernel,
@@ -609,8 +640,13 @@ def admm_solve(qp: CanonicalQP,
             pd = 0.0 if qp.Pdiag is None else qp.Pdiag
             Dv = sigma + pd + rho_b
             V = jnp.sqrt(jnp.asarray(2.0, dtype)) * qp.Pf
-            psolve0 = factored_spd_solve_operator(
-                Dv, V, refine_steps=params.woodbury_refine)
+            # Pieces built ONCE per segment and shared between the XLA
+            # solve closure and (on the pallas path) the fused kernel —
+            # XLA CSE is not guaranteed to merge two copies of the
+            # control-flow-bearing blocked triangular inverse.
+            inv_d_w, W_w = factored_solve_pieces(Dv, V)
+            psolve0 = factored_solve_from_pieces(
+                Dv, V, inv_d_w, W_w, refine_steps=params.woodbury_refine)
             hp = jax.lax.Precision.HIGHEST
             Y0 = jax.vmap(psolve0, in_axes=1, out_axes=1)(qp.C.T)  # (n, m)
             G = jnp.diag(1.0 / rho) + jnp.dot(qp.C, Y0, precision=hp)
@@ -632,27 +668,47 @@ def admm_solve(qp: CanonicalQP,
         if use_pallas:
             # Fused segment with the linear-solve operator VMEM-resident
             # across the whole check_interval (see
-            # porqua_tpu.ops.admm_kernel). With linsolve="trinv" (the
-            # TPU default) the resident matrix is L^-1 applied twice —
-            # the same accuracy story as the XLA trinv path; otherwise
-            # the refined explicit K^-1 applied once.
-            from porqua_tpu.ops.admm_kernel import admm_segment
+            # porqua_tpu.ops.admm_kernel). With linsolve="woodbury"
+            # (refine=0) the resident state is the capacitance pieces
+            # (W, inv_d, Y0, Ginv) — ~((T+m) x n) instead of n x n, so
+            # this form fits VMEM in the regimes where the dense kernel
+            # OOMs, and saves the XLA path's two W re-reads per
+            # iteration. With linsolve="trinv" the resident matrix is
+            # L^-1 applied twice — the same accuracy story as the XLA
+            # trinv path; otherwise the refined explicit K^-1 once.
+            from porqua_tpu.ops.admm_kernel import (admm_segment,
+                                                    admm_segment_factored)
 
-            if linsolve == "trinv":
-                op = triangular_inverse(K)
-                triangular = True
+            if linsolve == "woodbury":
+                # Ginv is explicit (m x m, tiny): the in-kernel row-
+                # Schur correction becomes one matvec. The XLA path LU-
+                # solves G per iteration instead; for the m's this path
+                # serves the explicit-inverse error is negligible.
+                Ginv = jnp.linalg.inv(G)
+                x, z, w, y, mu, dx, dy, dmu = admm_segment_factored(
+                    W_w, inv_d_w, Y0, Ginv, qp.C, qp.q, qp.l, qp.u, qp.lb,
+                    qp.ub, rho, rho_b, l1w, l1c,
+                    state.x, state.z, state.w, state.y, state.mu,
+                    sigma=params.sigma, alpha=params.alpha,
+                    n_iters=params.check_interval,
+                    interpret=jax.default_backend() != "tpu",
+                )
             else:
-                op = refined_inverse(K, cho_factor(K))
-                triangular = False
-            x, z, w, y, mu, dx, dy, dmu = admm_segment(
-                op, qp.C, qp.q, qp.l, qp.u, qp.lb, qp.ub, rho, rho_b,
-                l1w, l1c,
-                state.x, state.z, state.w, state.y, state.mu,
-                sigma=params.sigma, alpha=params.alpha,
-                n_iters=params.check_interval,
-                interpret=jax.default_backend() != "tpu",
-                triangular=triangular,
-            )
+                if linsolve == "trinv":
+                    op = triangular_inverse(K)
+                    triangular = True
+                else:
+                    op = refined_inverse(K, cho_factor(K))
+                    triangular = False
+                x, z, w, y, mu, dx, dy, dmu = admm_segment(
+                    op, qp.C, qp.q, qp.l, qp.u, qp.lb, qp.ub, rho, rho_b,
+                    l1w, l1c,
+                    state.x, state.z, state.w, state.y, state.mu,
+                    sigma=params.sigma, alpha=params.alpha,
+                    n_iters=params.check_interval,
+                    interpret=jax.default_backend() != "tpu",
+                    triangular=triangular,
+                )
         else:
             hp = jax.lax.Precision.HIGHEST
             if linsolve == "woodbury":

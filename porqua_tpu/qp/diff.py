@@ -65,6 +65,16 @@ from porqua_tpu.qp.solve import QPSolution, SolverParams, Status, solve_qp
 __all__ = ["solve_qp_diff", "solve_qp_l1_diff", "active_sets"]
 
 
+def _classification_tols(sol: QPSolution, dtype):
+    """(prox_tol, dual_tol) for active-set classification at a solved
+    point, both floored at 1e3*machine-eps and scaled with the
+    solution's own residuals — the gradient is taken on the piece the
+    *achieved* accuracy can actually distinguish."""
+    tiny = 1e3 * jnp.asarray(jnp.finfo(dtype).eps, dtype)
+    tol = jnp.maximum(tiny, 10.0 * jnp.maximum(sol.prim_res, sol.dual_res))
+    return tol, tol
+
+
 def active_sets(qp: CanonicalQP, sol: QPSolution):
     """Classify active rows/box coordinates at a solution.
 
@@ -77,11 +87,16 @@ def active_sets(qp: CanonicalQP, sol: QPSolution):
     boolean which-side indicators the bound cotangent routing uses.
     """
     dtype = qp.P.dtype
-    tiny = 1e3 * jnp.asarray(jnp.finfo(dtype).eps, dtype)
-    prox = jnp.maximum(tiny, 10.0 * jnp.maximum(sol.prim_res, sol.dual_res))
+    # BOTH thresholds scale with the solution's residuals (round-3
+    # advisor finding): at loose eps a residual dual of order the
+    # solver tolerance is noise, and a machine-eps dual_tol would read
+    # it as a decisively-signed active constraint. A truly active
+    # constraint whose dual is below the residual scale still
+    # classifies active through the on-bound proximity fallback.
+    prox, dual_tol = _classification_tols(sol, dtype)
 
     (act_low_C, act_up_C, eq_C, act_low_B, act_up_B, eq_B
-     ) = classify_active(qp, sol.z, sol.x, sol.y, sol.mu, prox, tiny)
+     ) = classify_active(qp, sol.z, sol.x, sol.y, sol.mu, prox, dual_tol)
     aC = ((act_low_C | act_up_C | eq_C) & (qp.row_mask > 0)).astype(dtype)
     up_side_C = act_up_C & ~act_low_C
     bound_C = jnp.where(up_side_C, qp.u, qp.l)
@@ -240,9 +255,8 @@ def _l1_bwd(params, res, g):
     g = g * ok
 
     x, mu = sol.x, sol.mu
-    tiny = 1e3 * jnp.asarray(jnp.finfo(dtype).eps, dtype)
     err = jnp.maximum(sol.prim_res, sol.dual_res)
-    prox = jnp.maximum(tiny, 10.0 * err)
+    prox, dual_tol = _classification_tols(sol, dtype)
     # Shared classification with the prox-aware polish: kink set, the
     # smooth-side signs, and the de-L1'd box dual come from ONE helper
     # (classify_l1), with `err` the solution's residual scale.
@@ -258,7 +272,7 @@ def _l1_bwd(params, res, g):
     dead_side = jnp.where(jnp.abs(x - c) > window, jnp.sign(x - c), 0.0)
     sign_s = jnp.where(w > 0, sign_s, dead_side).astype(dtype)
     (act_low_C, act_up_C, eq_C, act_low_B, act_up_B, eq_B
-     ) = classify_active(qp, sol.z, x, sol.y, mu_box, prox, tiny)
+     ) = classify_active(qp, sol.z, x, sol.y, mu_box, prox, dual_tol)
     aC = ((act_low_C | act_up_C | eq_C) & (qp.row_mask > 0)).astype(dtype)
     up_side_C = act_up_C & ~act_low_C
     box_act = (act_low_B | act_up_B | eq_B) & (qp.var_mask > 0)

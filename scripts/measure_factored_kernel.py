@@ -1,0 +1,49 @@
+"""Factored (capacitance) Pallas segment vs XLA woodbury, north-star batch.
+
+The round-4 kernel keeps (W, inv_d, Y0, Ginv) VMEM-resident across a
+whole 35-iteration segment; the XLA path re-reads W (0.5 MB/problem)
+twice per iteration — ~9 GB of HBM traffic at B=252 the kernel should
+shed. Decides whether backend="pallas" joins the TPU headline config.
+argv[1] = B (default 252), argv[2] = n_assets (default 500).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from porqua_tpu.profiling import measure_steady_state
+from porqua_tpu.qp.solve import SolverParams
+from porqua_tpu.tracking import synthetic_universe_np, tracking_step
+
+dev = jax.devices()[0]
+assert dev.platform == "tpu", dev
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 252
+n = int(sys.argv[2]) if len(sys.argv) > 2 else 500
+Xs_np, ys_np = synthetic_universe_np(seed=42, n_dates=B, window=252,
+                                     n_assets=n)
+Xs, ys = jnp.asarray(Xs_np), jnp.asarray(ys_np)
+
+for backend in ("xla", "pallas"):
+    params = SolverParams(max_iter=2000, eps_abs=1e-3, eps_rel=1e-3,
+                          polish=False, scaling_iters=2,
+                          linsolve="woodbury", woodbury_refine=0,
+                          check_interval=35, backend=backend,
+                          vmem_limit_mb=64.0)
+    try:
+        out = jax.jit(lambda X: tracking_step(X, ys, params))(Xs)
+        solved = int(jnp.sum(out.status == 1))
+        per = measure_steady_state(
+            lambda X: jnp.sum(tracking_step(X, ys, params).tracking_error),
+            Xs, k=3)
+        print(f"RESULT factored-kernel B={B} n={n} {backend}-woodbury: "
+              f"{per*1e3:.1f} ms, solved {solved}/{B}, "
+              f"iters {float(jnp.median(out.iters)):.0f}/"
+              f"{int(jnp.max(out.iters))}, "
+              f"TE {float(jnp.median(out.tracking_error)):.4e}", flush=True)
+    except Exception as e:
+        print(f"RESULT factored-kernel B={B} n={n} {backend}-woodbury: "
+              f"FAILED {type(e).__name__}: {e}", flush=True)

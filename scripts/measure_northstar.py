@@ -1,0 +1,48 @@
+"""North-star steady-state measurement at a given batch size (argv[1]).
+
+Standalone chip job for the round-4 queue (extracted from the round-3
+tpu_session_measure.py inline strings so jobs can be retried/edited
+independently). Prints RESULT lines; asserts it is on a real TPU.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from porqua_tpu.profiling import measure_steady_state
+from porqua_tpu.qp.solve import SolverParams
+from porqua_tpu.tracking import synthetic_universe_np, tracking_step
+
+dev = jax.devices()[0]
+assert dev.platform == "tpu", dev
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 252
+params = SolverParams(max_iter=2000, eps_abs=1e-3, eps_rel=1e-3,
+                      polish=False, scaling_iters=2)
+Xs_np, ys_np = synthetic_universe_np(seed=42, n_dates=B, window=252,
+                                     n_assets=500)
+Xs, ys = jnp.asarray(Xs_np), jnp.asarray(ys_np)
+out = jax.jit(lambda X: tracking_step(X, ys, params))(Xs)
+solved = int(jnp.sum(out.status == 1))
+per = measure_steady_state(
+    lambda X: jnp.sum(tracking_step(X, ys, params).tracking_error), Xs, k=3)
+print(f"RESULT northstar B={B}: {per*1e3:.1f} ms = {per/B*1e6:.1f} us/date, "
+      f"solved {solved}/{B}, "
+      f"TE {float(jnp.median(out.tracking_error)):.4e}", flush=True)
+
+# The promoted TPU headline config (woodbury/capacitance segments).
+pwb = SolverParams(max_iter=2000, eps_abs=1e-3, eps_rel=1e-3,
+                   polish=False, scaling_iters=2,
+                   linsolve="woodbury", woodbury_refine=0,
+                   check_interval=35)
+out3 = jax.jit(lambda X: tracking_step(X, ys, pwb))(Xs)
+solved3 = int(jnp.sum(out3.status == 1))
+per3 = measure_steady_state(
+    lambda X: jnp.sum(tracking_step(X, ys, pwb).tracking_error), Xs, k=3)
+print(f"RESULT northstar-woodbury B={B}: {per3*1e3:.1f} ms, "
+      f"solved {solved3}/{B}, "
+      f"iters {float(jnp.median(out3.iters)):.0f}/{int(jnp.max(out3.iters))}, "
+      f"TE {float(jnp.median(out3.tracking_error)):.4e}", flush=True)

@@ -1,0 +1,45 @@
+"""Pallas fused-segment crossover at n = argv[1], B = argv[2].
+
+Standalone chip job for the round-4 queue. Times xla-trinv (incumbent)
+against the Pallas backends at large n; a structural VMEM failure is a
+measured outcome (printed as RESULT ... FAILED), not an error.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from porqua_tpu.profiling import measure_steady_state
+from porqua_tpu.qp.solve import SolverParams, solve_qp_batch
+from porqua_tpu.tracking import build_tracking_qp, synthetic_universe_np
+
+dev = jax.devices()[0]
+assert dev.platform == "tpu", dev
+
+n = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
+B = int(sys.argv[2]) if len(sys.argv) > 2 else 16
+Xs_np, ys_np = synthetic_universe_np(seed=7, n_dates=B, window=252,
+                                     n_assets=n)
+Xs, ys = jnp.asarray(Xs_np), jnp.asarray(ys_np)
+qps = jax.jit(jax.vmap(build_tracking_qp))(Xs, ys)
+jax.block_until_ready(qps.P)
+
+for backend, linsolve in (("xla", "trinv"), ("pallas", "trinv"),
+                          ("pallas", "inverse")):
+    params = SolverParams(max_iter=2000, eps_abs=1e-3, eps_rel=1e-3,
+                          polish=False, scaling_iters=2, backend=backend,
+                          linsolve=linsolve, vmem_limit_mb=64.0)
+    try:
+        out = jax.jit(lambda q: solve_qp_batch(q, params))(qps)
+        solved = int(jnp.sum(out.status == 1))
+        per = measure_steady_state(
+            lambda q: jnp.sum(solve_qp_batch(q, params).x), qps, k=3)
+        print(f"RESULT pallas-xover n={n} B={B} {backend}-{linsolve}: "
+              f"{per*1e3:.1f} ms, solved {solved}/{B}, "
+              f"iters {float(jnp.median(out.iters)):.0f}", flush=True)
+    except Exception as e:
+        print(f"RESULT pallas-xover n={n} B={B} {backend}-{linsolve}: "
+              f"FAILED {type(e).__name__}: {e}", flush=True)

@@ -1,0 +1,8 @@
+# TIMEOUT: 900
+# ATTEMPTS: 3
+# SUCCESS: RESULT factored-kernel B=252 n=500 pallas-woodbury
+# Round-4 factored Pallas segment vs XLA woodbury at the north-star
+# shape — decides whether the kernel joins the TPU headline config
+# (projected: sheds ~9 GB of per-iteration W re-reads).
+python scripts/measure_factored_kernel.py 252 500 2>&1 | tee .tpu_queue/factored_kernel.log
+exit ${PIPESTATUS[0]}

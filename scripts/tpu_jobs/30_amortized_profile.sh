@@ -1,0 +1,7 @@
+# TIMEOUT: 1200
+# ATTEMPTS: 3
+# SUCCESS: step woodbury ruiz0
+# Stage profile + the Ruiz 0/1/2 sweep for the woodbury headline config
+# (roofline item: candidate 35 -> ~29 ms by shedding Ruiz re-reads).
+python scripts/profile_amortized.py 2>&1 | tee .tpu_queue/profile_amortized_r04.log
+exit ${PIPESTATUS[0]}

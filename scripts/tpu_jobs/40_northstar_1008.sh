@@ -1,0 +1,6 @@
+# TIMEOUT: 1500
+# ATTEMPTS: 3
+# SUCCESS: RESULT northstar-woodbury B=1008
+# Batch-scaling evidence at B=1008 (trinv + woodbury headline config).
+python scripts/measure_northstar.py 1008 2>&1 | tee .tpu_queue/northstar_1008.log
+exit ${PIPESTATUS[0]}

@@ -1,0 +1,7 @@
+# TIMEOUT: 900
+# ATTEMPTS: 3
+# SUCCESS: RESULT pallas-xover n=2000 B=8 pallas-inverse
+# Kernel crossover at n=2000 (round-3 attempts OOMed; a structural VMEM
+# failure printed as RESULT ... FAILED still counts as measured).
+python scripts/measure_pallas_xover.py 2000 8 2>&1 | tee .tpu_queue/pallas_xover_2000.log
+exit ${PIPESTATUS[0]}

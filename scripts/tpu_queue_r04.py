@@ -1,0 +1,157 @@
+"""Round-4 chip-session queue: probe-gated, directory-driven job runner.
+
+The axon tunnel black-holes rather than failing fast and historically
+serves rare short windows (round 3 saw ONE 8-minute window in ~14 h).
+This runner polls a cheap probe all session and, the moment it
+succeeds, fires pending jobs in priority order — so chip work lands in
+whatever window appears, without a human in the loop.
+
+Jobs live in ``scripts/tpu_jobs/NN_name.sh`` and are re-scanned every
+cycle, so new jobs can be added while the runner is live (this is the
+round-4 change vs the round-3 fixed job list: the tiled-kernel and
+LAD-at-scale jobs don't exist yet when the runner starts). Header
+directives, parsed from leading comment lines:
+
+    # TIMEOUT: 900        child wall-clock cap (seconds)
+    # ATTEMPTS: 3         max attempts before the job is parked
+    # SUCCESS: regex      job is done iff rc==0 AND regex in output
+
+State/markers/logs in ``.tpu_queue/`` (gitignored). Every job runs
+with a persistent XLA compilation cache (JAX_COMPILATION_CACHE_DIR)
+so a retry after a tunnel flap re-compiles from disk in seconds —
+round 3 lost its only window's tail to a ~60-90 s compile.
+"""
+import os
+import re
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+JOB_DIR = os.path.join(ROOT, "scripts", "tpu_jobs")
+STATE = os.path.join(ROOT, ".tpu_queue")
+DEADLINE_H = float(os.environ.get("TPU_QUEUE_HOURS", 11.5))
+PROBE_TIMEOUT = int(os.environ.get("TPU_PROBE_TIMEOUT", 90))
+SLEEP_S = int(os.environ.get("TPU_RETRY_SLEEP", 110))
+
+PROBE = r'''
+import jax, numpy as np, jax.numpy as jnp
+dev = jax.devices()[0]
+assert dev.platform == "tpu", dev
+np.asarray(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+print("PROBEOK", dev.device_kind, flush=True)
+'''
+
+
+def log(*a):
+    print(time.strftime("[%H:%M:%S]"), *a, flush=True)
+
+
+def probe() -> bool:
+    try:
+        p = subprocess.run([sys.executable, "-c", PROBE],
+                           capture_output=True, text=True,
+                           timeout=PROBE_TIMEOUT)
+        return p.returncode == 0 and "PROBEOK" in p.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def parse_header(path):
+    cfg = {"TIMEOUT": 900, "ATTEMPTS": 3, "SUCCESS": None}
+    with open(path) as f:
+        for line in f:
+            m = re.match(r"#\s*(TIMEOUT|ATTEMPTS|SUCCESS):\s*(.+)", line)
+            if m:
+                k, v = m.group(1), m.group(2).strip()
+                cfg[k] = int(v) if k in ("TIMEOUT", "ATTEMPTS") else v
+            elif line.strip() and not line.startswith("#"):
+                break
+    return cfg
+
+
+def attempts_of(name):
+    p = os.path.join(STATE, name + ".attempts")
+    return int(open(p).read()) if os.path.exists(p) else 0
+
+
+def bump_attempts(name):
+    p = os.path.join(STATE, name + ".attempts")
+    with open(p, "w") as f:
+        f.write(str(attempts_of(name) + 1))
+
+
+def pending_jobs():
+    if not os.path.isdir(JOB_DIR):
+        return []
+    out = []
+    for fn in sorted(os.listdir(JOB_DIR)):
+        if not fn.endswith(".sh"):
+            continue
+        name = fn[:-3]
+        if os.path.exists(os.path.join(STATE, name + ".done")):
+            continue
+        cfg = parse_header(os.path.join(JOB_DIR, fn))
+        if attempts_of(name) >= cfg["ATTEMPTS"]:
+            continue
+        out.append((name, os.path.join(JOB_DIR, fn), cfg))
+    return out
+
+
+def run_job(name, path, cfg):
+    bump_attempts(name)
+    logp = os.path.join(STATE, name + ".log")
+    env = dict(os.environ)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(ROOT, ".xla_cache"))
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+    log(f"job {name} attempt {attempts_of(name)}/{cfg['ATTEMPTS']} "
+        f"(timeout {cfg['TIMEOUT']}s)")
+    t0 = time.monotonic()
+    try:
+        p = subprocess.run(["bash", path], capture_output=True, text=True,
+                           timeout=cfg["TIMEOUT"], env=env, cwd=ROOT)
+        out, rc = p.stdout + p.stderr, p.returncode
+    except subprocess.TimeoutExpired as e:
+        def _s(b):
+            return b.decode(errors="replace") if isinstance(b, bytes) else (b or "")
+        out, rc = _s(e.stdout) + _s(e.stderr), -9
+    with open(logp, "a") as f:
+        f.write(f"\n===== attempt {attempts_of(name)} rc={rc} "
+                f"{time.strftime('%H:%M:%S')} "
+                f"({time.monotonic()-t0:.0f}s) =====\n")
+        f.write(out)
+    ok = rc == 0 and (cfg["SUCCESS"] is None
+                      or re.search(cfg["SUCCESS"], out) is not None)
+    if ok:
+        open(os.path.join(STATE, name + ".done"), "w").write("ok\n")
+    log(f"job {name}: rc={rc} {'DONE' if ok else 'failed'} "
+        f"in {time.monotonic()-t0:.0f}s; tail: {out.strip()[-160:]!r}")
+    return ok
+
+
+def main():
+    os.makedirs(STATE, exist_ok=True)
+    t_end = time.monotonic() + DEADLINE_H * 3600
+    n_probe = 0
+    while time.monotonic() < t_end:
+        jobs = pending_jobs()
+        if not jobs:
+            log("no pending jobs; sleeping 300s (job dir is re-scanned)")
+            time.sleep(300)
+            continue
+        n_probe += 1
+        if not probe():
+            if n_probe % 10 == 1:
+                log(f"probe {n_probe}: tunnel down; "
+                    f"{len(jobs)} jobs pending ({jobs[0][0]} next)")
+            time.sleep(SLEEP_S)
+            continue
+        log(f"probe {n_probe}: TUNNEL UP — running {jobs[0][0]}")
+        run_job(*jobs[0])
+        # Re-probe before the next job: a flap mid-window is the norm.
+    log("queue deadline reached")
+
+
+if __name__ == "__main__":
+    main()

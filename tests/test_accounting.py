@@ -183,6 +183,12 @@ def test_performance_summary_metrics(rng):
     np.testing.assert_allclose(perf["var_95"], r.quantile(0.05), rtol=1e-12)
     np.testing.assert_allclose(
         perf["cumulative_return"], levels.iloc[-1] - 1, rtol=1e-12)
+    # annual_return is CAGR from the level path (quantstats
+    # convention), so it must be consistent with cumulative_return:
+    # (1 + annual) ** (n/252) == 1 + cumulative.
+    np.testing.assert_allclose(
+        (1 + perf["annual_return"]) ** (500 / 252),
+        levels.iloc[-1], rtol=1e-10)
     np.testing.assert_allclose(
         perf["tracking_error"], (r - bench).std() * np.sqrt(252),
         rtol=1e-12)
