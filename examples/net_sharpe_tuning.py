@@ -1,0 +1,149 @@
+"""Differentiable END-TO-END P&L: tune the turnover knob on net Sharpe.
+
+This composes the two device engines nothing in the reference can
+chain a gradient through (its solver boundary is qpsolvers, its P&L a
+pandas loop — ``src/qp_problems.py:211``, ``src/portfolio.py:205-245``):
+
+    lambda -> [scan over rebalances: tracking QP + native L1 turnover
+               prox, each date's solution seeding the next date's L1
+               center]                      (porqua_tpu.qp.diff)
+           -> rebalance weights (D, N)
+           -> the device accounting engine: drifted weights, levels,
+              turnover, NET returns after variable costs
+                                            (porqua_tpu.accounting)
+           -> annualized net Sharpe
+
+and differentiates the whole pipeline in ONE ``jax.grad`` — the
+optimizer's churn-control knob lambda is tuned directly against the
+money the strategy actually keeps, costs, drift, and compounding
+included. A finite-difference cross-check validates the gradient at
+the optimum found.
+
+Run: python examples/net_sharpe_tuning.py  (CPU, ~2 min)
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp
+import numpy as np
+
+from porqua_tpu.accounting import simulate
+from porqua_tpu.qp.diff import solve_qp_l1_diff
+from porqua_tpu.qp.solve import SolverParams
+from porqua_tpu.tracking import build_tracking_qp
+
+PARAMS = SolverParams(max_iter=20000, eps_abs=1e-9, eps_rel=1e-9)
+VC = 0.01               # the market's actual cost per unit turnover
+N, WINDOW, D, STEP = 12, 42, 10, 21
+ANN = 252
+
+
+def make_market(seed=7):
+    """Synthetic market where the *benchmark carries alpha*: a sparse
+    basket that slowly rotates into the assets whose drift is
+    temporarily high. Tracking it closely captures the alpha but
+    churns; freezing the portfolio saves costs but loses the rotation.
+    The knob lambda trades exactly that off, so net Sharpe has an
+    interior optimum in lambda."""
+    rng = np.random.default_rng(seed)
+    T = WINDOW + D * STEP + 1
+    k = 3
+    B = 0.5 + 0.5 * rng.random((N, k))
+    F = 0.009 * rng.standard_normal((T, k))
+    noise = 0.004 * rng.standard_normal((T, N))
+    mu = np.full((T, N), 0.0006)     # common market drift
+    w_bm = np.zeros((T, N))
+    idx = rng.choice(N, 4, replace=False)
+    hold = rng.dirichlet(np.ones(4))
+    for t in range(T):
+        if t % (2 * STEP) == 0 and t:
+            idx = rng.choice(N, 4, replace=False)
+            hold = rng.dirichlet(np.ones(4))
+        w_bm[t, idx] = hold
+        mu[t, idx] += 0.0035         # the rotating alpha (~0.35%/day)
+    R = F @ B.T + mu + noise
+    y = np.einsum("tn,tn->t", R, w_bm) + 0.0005 * rng.standard_normal(T)
+    reb_idx = np.arange(WINDOW, WINDOW + D * STEP, STEP)
+    return jnp.asarray(R), jnp.asarray(y), jnp.asarray(reb_idx)
+
+
+R, y_bm, reb_idx = make_market()
+Xs = jnp.stack([jax.lax.dynamic_slice_in_dim(R, int(i) - WINDOW, WINDOW)
+                for i in reb_idx])
+ys = jnp.stack([jax.lax.dynamic_slice_in_dim(y_bm, int(i) - WINDOW, WINDOW)
+                for i in reb_idx])
+w0 = jnp.full((N,), 1.0 / N)
+
+
+def weights_chain(lam):
+    """All D rebalance solves, turnover-coupled through the L1 center."""
+    def body(w_prev, Xy):
+        X, yb = Xy
+        w = solve_qp_l1_diff(build_tracking_qp(X, yb),
+                             jnp.full(N, lam), w_prev, PARAMS)
+        return w, w
+
+    _, ws = jax.lax.scan(body, w0, (Xs, ys))
+    return ws
+
+
+def net_sharpe(lam):
+    ws = weights_chain(lam)
+    sim = simulate(ws, R, reb_idx, vc=VC)
+    nv = jnp.sum(sim.valid)
+    mean = jnp.sum(sim.returns) / nv
+    var = jnp.sum(jnp.where(sim.valid, (sim.returns - mean) ** 2, 0.0)) / (
+        nv - 1.0)
+    return mean / jnp.sqrt(var) * jnp.sqrt(float(ANN))
+
+
+def main():
+    # Tune theta = log(lambda): multiplicative steps cannot rail the
+    # knob against a clip bound in one update, and the scale of
+    # dS/dtheta = lambda * dS/dlambda is self-normalizing.
+    val_and_grad = jax.jit(jax.value_and_grad(
+        lambda th: net_sharpe(jnp.exp(th))))
+    # Start on the disciplined (high-lambda) side of the live region:
+    # the net-Sharpe landscape is multimodal (chase-everything is a
+    # separate, worse local basin at lambda ~ 1e-4) and above ~3e-3
+    # every coordinate kink-rests, the solution is locally constant in
+    # lambda, and the (correct) gradient is identically zero — the
+    # piecewise-smooth solution map only promises a local ascent from
+    # where the knob still bites.
+    theta = jnp.log(jnp.asarray(8e-4, jnp.float64))
+    lr = 0.4
+    print(f"actual cost vc={VC}; tuning the solver's lambda on NET Sharpe")
+    for it in range(14):
+        s, g = val_and_grad(theta)
+        lam = float(jnp.exp(theta))
+        to = float(jnp.sum(simulate(weights_chain(jnp.exp(theta)), R,
+                                    reb_idx, vc=VC).turnover))
+        print(f"  it {it:2d}: lambda {lam:.5f}  net Sharpe "
+              f"{float(s):+.3f}  dS/dtheta {float(g):+8.3f}  "
+              f"total turnover {to:.2f}", flush=True)
+        theta = theta + lr * jnp.clip(g, -2.0, 2.0)
+
+    # Gradient sanity at the end point: central finite difference.
+    lam = jnp.exp(theta)
+    h = 1e-6
+    fd = (float(net_sharpe(lam + h)) - float(net_sharpe(lam - h))) / (2 * h)
+    g = float(jax.grad(net_sharpe)(lam))
+    print(f"FD check at lambda={float(lam):.5f}: grad {g:+.4f} "
+          f"vs FD {fd:+.4f}")
+    s_final = float(net_sharpe(lam))
+    s_zero = float(net_sharpe(jnp.asarray(1e-6)))
+    s_frozen = float(net_sharpe(jnp.asarray(0.1)))
+    print(f"net Sharpe: chase-everything (lambda~0) {s_zero:+.3f}, "
+          f"frozen (lambda=0.1) {s_frozen:+.3f}, tuned {s_final:+.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -15,6 +15,15 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import jax
+
+# The axon sitecustomize pins jax_platforms at the config level, which
+# silently overrides the env var and then hangs/fails device init
+# against a dead tunnel — re-assert any explicit platform request.
+_env_plat = os.environ.get("JAX_PLATFORMS")
+if _env_plat and "axon" not in _env_plat:
+    jax.config.update("jax_platforms", _env_plat)
+
 import numpy as np
 
 N = int(os.environ.get("LAD_N", 500))
@@ -34,25 +43,25 @@ def build_lad_qp(rng, n, t, dtype):
 
     Xs, ys = synthetic_universe_np(seed=11, n_dates=1, window=t, n_assets=n)
     X, y = Xs[0].astype(np.float64), ys[0].astype(np.float64)
-    cons = Constraints(ids=[f"a{i}" for i in range(n)])
+    cons = Constraints(selection=[f"a{i}" for i in range(n)])
     cons.add_budget()
     cons.add_box(lower=0.0, upper=1.0)
     lad = LAD(dtype=getattr(jnp, dtype))
     lad.constraints = cons
     lad.objective = {"X": X, "y": y}
     qp = lad.model_canonical()
-    return qp, X, y
+    return qp, lad.canonical_parts(), X, y
 
 
 def main():
     import jax
     import jax.numpy as jnp
 
-    from porqua_tpu.qp.ipm import solve_qp_ipm
+    from porqua_tpu.qp.ipm import solve_ipm
     from porqua_tpu.qp.solve import SolverParams, solve_qp
 
     rng = np.random.default_rng(11)
-    qp, X, y = build_lad_qp(rng, N, T, DTYPE)
+    qp, parts, X, y = build_lad_qp(rng, N, T, DTYPE)
     print(f"LAD epigraph LP: n={qp.n} m={qp.m} dtype={qp.P.dtype}",
           flush=True)
 
@@ -61,7 +70,7 @@ def main():
 
     # f64 IPM oracle (the accuracy yardstick).
     t0 = time.perf_counter()
-    ipm = solve_qp_ipm(qp, tol=1e-9)
+    ipm = solve_ipm(parts, tol=1e-9)
     t_ipm = time.perf_counter() - t0
     w_ipm = np.asarray(ipm.x)[:N]
     obj_ipm = lad_objective(w_ipm)
@@ -79,14 +88,16 @@ def main():
                                              eps_rel=1e-4)),
     ]
     for label, params in configs:
+        sol = solve_qp(qp, params)          # warm (compile)
+        jax.block_until_ready(sol.x)
         t0 = time.perf_counter()
-        sol = jax.jit(lambda: solve_qp(qp, params)).lower().compile()()
+        sol = solve_qp(qp, params)
         jax.block_until_ready(sol.x)
         t_dev = time.perf_counter() - t0
         w = np.asarray(sol.x)[:N]
         obj = lad_objective(w)
         gap = (obj - obj_ipm) / max(abs(obj_ipm), 1e-12)
-        print(f"RESULT lad {label}: {t_dev:.1f}s (incl compile), "
+        print(f"RESULT lad {label}: {t_dev:.1f}s (warm), "
               f"status {int(sol.status)}, iters {int(sol.iters)}, "
               f"obj {obj:.8f} (rel gap {gap:+.2e}), "
               f"sum w {np.sum(w):.2e}, min w {np.min(w):.2e}", flush=True)

@@ -23,6 +23,7 @@ round 3 lost its only window's tail to a ~60-90 s compile.
 """
 import os
 import re
+import signal
 import subprocess
 import sys
 import time
@@ -108,14 +109,24 @@ def run_job(name, path, cfg):
     log(f"job {name} attempt {attempts_of(name)}/{cfg['ATTEMPTS']} "
         f"(timeout {cfg['TIMEOUT']}s)")
     t0 = time.monotonic()
+    # start_new_session + killpg: a timeout must take down the whole
+    # job tree. Killing only the bash wrapper leaves the hung python
+    # grandchild (the exact black-holed-tunnel case this runner exists
+    # for) alive and holding the TPU runtime, poisoning every later
+    # attempt in the session.
+    proc = subprocess.Popen(["bash", path], stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True, env=env,
+                            cwd=ROOT, start_new_session=True)
     try:
-        p = subprocess.run(["bash", path], capture_output=True, text=True,
-                           timeout=cfg["TIMEOUT"], env=env, cwd=ROOT)
-        out, rc = p.stdout + p.stderr, p.returncode
-    except subprocess.TimeoutExpired as e:
-        def _s(b):
-            return b.decode(errors="replace") if isinstance(b, bytes) else (b or "")
-        out, rc = _s(e.stdout) + _s(e.stderr), -9
+        out, _ = proc.communicate(timeout=cfg["TIMEOUT"])
+        rc = proc.returncode
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        out, _ = proc.communicate()
+        out, rc = out or "", -9
     with open(logp, "a") as f:
         f.write(f"\n===== attempt {attempts_of(name)} rc={rc} "
                 f"{time.strftime('%H:%M:%S')} "

@@ -1,0 +1,98 @@
+"""Worker process for the two-process multihost test (not a pytest
+module — spawned by ``tests/test_parallel.py::test_two_process_multihost``).
+
+Each of the two processes contributes 4 virtual CPU devices, joins the
+fleet through ``init_distributed`` (the repo's wrapper, including its
+process-count consistency check), builds the hosts x dates hybrid mesh,
+places one globally-sharded batch of tracking QPs, and solves it with
+the SAME batched program as single-chip. Each process then checks its
+own addressable shards against a locally-computed unsharded reference —
+cross-process agreement follows because both references are
+deterministic and identical.
+
+Usage: multihost_worker.py <process_id> <num_processes> <port>
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+pid = int(sys.argv[1])
+nproc = int(sys.argv[2])
+port = int(sys.argv[3])
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp
+import numpy as np
+
+from porqua_tpu.parallel.mesh import (batch_sharding, init_distributed,
+                                      make_multihost_mesh)
+from porqua_tpu.qp.solve import SolverParams, solve_qp_batch
+from porqua_tpu.tracking import build_tracking_qp, synthetic_universe
+
+got = init_distributed(coordinator_address=f"localhost:{port}",
+                       num_processes=nproc, process_id=pid)
+assert got == nproc, (got, nproc)
+assert len(jax.local_devices()) == 4
+assert len(jax.devices()) == 4 * nproc
+
+mesh = make_multihost_mesh()
+assert mesh.devices.shape == (nproc, 4), mesh.devices.shape
+assert mesh.axis_names == ("hosts", "dates")
+
+# Deterministic batch, identical in every process.
+B = 16
+Xs, ys = synthetic_universe(jax.random.PRNGKey(5), n_dates=B, window=24,
+                            n_assets=12, dtype=jnp.float64)
+qp = jax.vmap(build_tracking_qp)(Xs, ys)
+qp_np = jax.tree.map(np.asarray, qp)
+
+# Global placement: the batch axis split over BOTH mesh axes (pure data
+# parallelism — 2 dates per virtual chip). Each process provides the
+# values for its own addressable shards out of the shared full array.
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+sharding = NamedSharding(mesh, P(("hosts", "dates")))
+
+
+def put_global(arr):
+    spec = P(("hosts", "dates"), *([None] * (arr.ndim - 1)))
+    s = NamedSharding(mesh, spec)
+    return jax.make_array_from_callback(arr.shape, s, lambda idx: arr[idx])
+
+
+qp_global = jax.tree.map(put_global, qp_np)
+
+params = SolverParams(max_iter=2000, eps_abs=1e-8, eps_rel=1e-8,
+                      linsolve="chol")
+sol = solve_qp_batch(qp_global, params)
+jax.block_until_ready(sol.x)
+
+# Local reference: the identical batch, unsharded, on this process's
+# first device only.
+ref = solve_qp_batch(jax.tree.map(jnp.asarray, qp_np), params)
+ref_x = np.asarray(ref.x)
+assert np.all(np.asarray(ref.status) == 1)
+
+maxdiff = 0.0
+n_rows = 0
+for shard in sol.x.addressable_shards:
+    rows = np.asarray(shard.data)
+    idx = shard.index[0]
+    maxdiff = max(maxdiff, float(np.max(np.abs(rows - ref_x[idx]))))
+    n_rows += rows.shape[0]
+assert n_rows == B // nproc, (n_rows, B, nproc)
+
+# batch_sharding must agree with the placement this worker used.
+assert batch_sharding(mesh, qp_np.P.ndim, 1).spec[0] == "hosts"
+
+print(f"MULTIHOST OK pid={pid} procs={got} shard_rows={n_rows} "
+      f"maxdiff={maxdiff:.2e}", flush=True)
+assert maxdiff < 1e-12

@@ -713,3 +713,51 @@ def test_grad_f32_agrees_with_f64_direction():
                   SolverParams(max_iter=20000, eps_abs=1e-6, eps_rel=1e-6))
     assert np.sign(g64) == np.sign(g32)
     np.testing.assert_allclose(g32, g64, rtol=0.1)
+
+
+def test_grad_through_net_pnl_accounting():
+    """End-to-end P&L differentiability (round-4 verdict item 7): the
+    turnover-coupled scan of native-L1 solves composes with the DEVICE
+    ACCOUNTING ENGINE (accounting.simulate — drifted weights, levels,
+    variable costs) into a net-Sharpe objective, and d(net Sharpe)/
+    d(lambda) through solver + P&L + compounding matches finite
+    differences. This is the gradient examples/net_sharpe_tuning.py
+    ascends."""
+    from porqua_tpu.accounting import simulate
+    from porqua_tpu.qp.diff import solve_qp_l1_diff
+
+    rng = np.random.default_rng(21)
+    n, window, d_reb, step = 6, 16, 3, 8
+    T = window + d_reb * step + 1
+    R = jnp.asarray(rng.standard_normal((T, n)) * 0.01
+                    + 0.0004 * rng.standard_normal(n))
+    w_true = rng.dirichlet(np.ones(n))
+    y = R @ jnp.asarray(w_true) + 0.001 * jnp.asarray(
+        rng.standard_normal(T))
+    reb_idx = jnp.arange(window, window + d_reb * step, step)
+    Xs = jnp.stack([R[int(i) - window:int(i)] for i in reb_idx])
+    ys = jnp.stack([y[int(i) - window:int(i)] for i in reb_idx])
+    w0 = jnp.full((n,), 1.0 / n)
+
+    def net_sharpe(lam):
+        def body(w_prev, Xy):
+            X, yb = Xy
+            w = solve_qp_l1_diff(_build_qp(X, yb, ub=1.0, ridge=0.01),
+                                 jnp.full(n, lam), w_prev, PARAMS)
+            return w, w
+
+        _, ws = jax.lax.scan(body, w0, (Xs, ys))
+        sim = simulate(ws, R, reb_idx, vc=0.005)
+        nv = jnp.sum(sim.valid)
+        mean = jnp.sum(sim.returns) / nv
+        var = jnp.sum(jnp.where(sim.valid, (sim.returns - mean) ** 2,
+                                0.0)) / (nv - 1.0)
+        return mean / jnp.sqrt(var) * jnp.sqrt(252.0)
+
+    lam0 = 4e-4  # inside the live region: some coordinates move
+    g = float(jax.grad(net_sharpe)(jnp.asarray(lam0, jnp.float64)))
+    h = 1e-7
+    fd = (float(net_sharpe(jnp.asarray(lam0 + h)))
+          - float(net_sharpe(jnp.asarray(lam0 - h)))) / (2 * h)
+    np.testing.assert_allclose(g, fd, rtol=1e-3, atol=1e-6)
+    assert abs(g) > 1e-3  # the P&L is genuinely lambda-sensitive

@@ -4,6 +4,7 @@ Runs on the 8 virtual CPU devices configured in conftest; the same
 program shards over real TPU ICI unchanged.
 """
 
+import os
 import numpy as np
 import pytest
 
@@ -195,3 +196,42 @@ def test_multihost_mesh_single_process_degenerates():
         max_iter=2000, eps_abs=1e-8, eps_rel=1e-8, linsolve="chol"))
     np.testing.assert_allclose(np.asarray(sol.x), np.asarray(ref.x),
                                rtol=0, atol=1e-12)
+
+
+def test_two_process_multihost():
+    """The DCN axis for real (round-4 verdict item 8): TWO processes,
+    each with 4 virtual CPU devices, joined via jax.distributed with a
+    local coordinator — init_distributed's consistency check, the
+    hosts x dates hybrid mesh at its true (2, 4) shape, a globally
+    sharded batch, and per-process shard parity against an unsharded
+    reference all run in the spawned workers (tests/multihost_worker.py)."""
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+
+    worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(i), "2", str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env)
+        for i in range(2)
+    ]
+    outs = []
+    for i, p in enumerate(procs):
+        try:
+            out, err = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append((p.returncode, out, err))
+    for i, (rc, out, err) in enumerate(outs):
+        assert rc == 0, f"worker {i} rc={rc}\n{err[-2000:]}"
+        assert f"MULTIHOST OK pid={i} procs=2 shard_rows=8" in out, out
