@@ -385,8 +385,16 @@ def device_child(platform: str, n_dates: int) -> None:
         # this mode poisoned at eq_scale 1e3). The CPU fallback keeps
         # linsolve="auto" (-> trinv at f32): XLA-CPU timings of the
         # capacitance path were not re-validated at the fallback size.
+        # Round 4 adds scaling_mode="factored": the scaling diagonal
+        # comes from the objective factor (Jacobi), shedding every
+        # dense-P Ruiz sweep. Validated at bench scale on XLA-CPU
+        # (32/32 solved, one clean 35-iteration segment — the Ruiz
+        # straggler lane at 70 iters disappears — TE 6.2661e-4 vs Ruiz
+        # 6.2658e-4) and pinned by tests/test_woodbury.py; on-chip
+        # validation is in the round-4 hardware test set.
         params = dataclasses.replace(base_params, linsolve="woodbury",
-                                     woodbury_refine=0, check_interval=35)
+                                     woodbury_refine=0, check_interval=35,
+                                     scaling_mode="factored")
 
     t0 = time.perf_counter()
     out = tracking_step_jit(Xs, ys, params)

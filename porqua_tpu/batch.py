@@ -409,7 +409,12 @@ def run_batch(bs: BacktestService,
     for date-independent strategies, but every date solves concurrently
     in one XLA program.
     """
-    params = SolverParams() if params is None else params
+    # Default to the strategy's OWN solver configuration, like the
+    # serial engine does — strategies inject problem-class-appropriate
+    # settings (LAD: fixed LP step size; the old SolverParams() default
+    # silently discarded them in batch mode).
+    params = (bs.optimization.params.to_solver_params()
+              if params is None else params)
     problems = build_problems(bs, dtype=dtype)
     solution = solve_batch(problems, params)
     return assemble_backtest(problems, solution)

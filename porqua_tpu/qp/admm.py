@@ -148,6 +148,13 @@ class SolverParams:
     alpha: float = 1.6
     adaptive_rho: bool = True
     scaling_iters: int = 10
+    # "ruiz": modified Ruiz sweeps over the dense P (scaling_iters of
+    # them). "factored": Jacobi scaling computed from the objective
+    # factor alone (requires qp.Pf) — same solve quality on Gram-matrix
+    # workloads at a fraction of the HBM traffic; see
+    # ruiz.equilibrate_factored. Opt-in (the bench's TPU headline
+    # config uses it); "ruiz" stays the general-purpose default.
+    scaling_mode: str = "ruiz"
     polish: bool = True
     polish_delta: float = 1e-7
     polish_refine_steps: int = 3

@@ -80,7 +80,20 @@ def equilibrate(qp: CanonicalQP, iters: int = 10) -> Tuple[CanonicalQP, Scaling]
         jnp.ones(n, dtype), jnp.ones(m, dtype), jnp.asarray(1.0, dtype),
     )
     (P, q, C, D, E, c), _ = jax.lax.scan(body, init, None, length=iters)
+    return _apply_scaling(qp, P, q, C, D, E, c), Scaling(D=D, E=E, c=c)
 
+
+def _apply_scaling(qp: CanonicalQP, P, q, C, D, E, c) -> CanonicalQP:
+    """Assemble the scaled problem from (already-scaled) P/q/C and the
+    diagonal scalings — ONE copy of the bounds/constant/factor scaling
+    conventions shared by every equilibration mode (a drifted second
+    copy would silently give the modes different unscale semantics).
+
+    Conventions: l,u scale by E; lb,ub by 1/D; constant by c; the
+    objective factor as P = 2 Pf'Pf + diag(Pdiag) -> c D P D =
+    2 (sqrt(c) Pf D)'(sqrt(c) Pf D) + diag(c D^2 Pdiag), so the
+    Woodbury solve path stays available on the scaled problem.
+    """
     scaled = qp._replace(
         P=P,
         q=q,
@@ -92,11 +105,66 @@ def equilibrate(qp: CanonicalQP, iters: int = 10) -> Tuple[CanonicalQP, Scaling]
         constant=qp.constant * c,
     )
     if qp.Pf is not None:
-        # P = 2 Pf'Pf + diag(Pdiag) -> c D P D = 2 (sqrt(c) Pf D)' (...)
-        # + diag(c D^2 Pdiag): the factor form survives diagonal scaling,
-        # so the Woodbury solve path stays available on the scaled
-        # problem.
         scaled = scaled._replace(Pf=jnp.sqrt(c) * qp.Pf * D[None, :])
         if qp.Pdiag is not None:
             scaled = scaled._replace(Pdiag=c * D * D * qp.Pdiag)
+    return scaled
+
+
+def equilibrate_factored(qp: CanonicalQP) -> Tuple[CanonicalQP, Scaling]:
+    """Jacobi equilibration computed from the objective FACTOR alone.
+
+    Each modified-Ruiz sweep above reads the dense n x n ``P`` three
+    times and writes it once — for the north-star batch that is the
+    single largest HBM consumer outside the ADMM iterations
+    (BASELINE.md roofline notes). When the problem carries its factor
+    (``P = 2 Pf'Pf + diag(Pdiag)``), the diagonal is available from
+    column norms of ``Pf`` — a (T x n) read, ~T/n of the dense bytes —
+    and Jacobi scaling ``D_j = P_jj^(-1/2)`` (unit scaled diagonal) is
+    the SPD-natural diagonal equilibration (van der Sluis: within a
+    factor of the optimal diagonal conditioning). The scaled dense P is
+    then materialized in ONE fused read+write, so total P traffic drops
+    from ~4 passes/sweep to 2 passes flat.
+
+    Scope: requires ``qp.Pf``; callers opt in via
+    ``SolverParams.scaling_mode="factored"``. Iteration-count parity
+    with 2-sweep Ruiz on the tracking workload is pinned by
+    ``tests/test_woodbury.py``; quality on real data by the MSCI sweep.
+    """
+    if qp.Pf is None:
+        raise ValueError("equilibrate_factored requires the factored "
+                         "objective (qp.Pf)")
+    dtype = qp.P.dtype
+    n, m = qp.n, qp.m
+
+    diagP = 2.0 * jnp.sum(qp.Pf * qp.Pf, axis=-2)
+    if qp.Pdiag is not None:
+        diagP = diagP + qp.Pdiag
+    # Masked/padded columns carry a zero diagonal; scale them by 1.
+    D = jnp.where(diagP > 1e-12, 1.0 / jnp.sqrt(jnp.maximum(diagP, 1e-12)),
+                  1.0)
+
+    # Constraint rows: one pass over C (m x n), Ruiz-style row norms of
+    # the column-scaled matrix.
+    if m:
+        row_norm = jnp.max(jnp.abs(qp.C) * D[None, :], axis=1)
+        E = jnp.where(row_norm > 1e-8, 1.0 / row_norm, 1.0)
+    else:
+        E = jnp.ones((0,), dtype)
+
+    # Cost normalization: the scaled P has unit diagonal (mean col
+    # norm ~ 1 for the Gram matrices this path serves), so only |D q|
+    # can push the cost scale around.
+    gamma_denom = jnp.maximum(1.0, jnp.max(jnp.abs(D * qp.q)))
+    c = jnp.asarray(1.0 / gamma_denom, dtype)
+    D = D.astype(dtype)
+    E = E.astype(dtype)
+
+    scaled = _apply_scaling(
+        qp,
+        c * D[:, None] * qp.P * D[None, :],
+        c * D * qp.q,
+        E[:, None] * qp.C * D[None, :],
+        D, E, c,
+    )
     return scaled, Scaling(D=D, E=E, c=c)

@@ -27,7 +27,7 @@ from porqua_tpu.qp.admm import (
 )
 from porqua_tpu.qp.canonical import CanonicalQP
 from porqua_tpu.qp.polish import polish_iterate as _polish_iterate
-from porqua_tpu.qp.ruiz import Scaling, equilibrate
+from porqua_tpu.qp.ruiz import Scaling, equilibrate, equilibrate_factored
 
 
 class QPSolution(NamedTuple):
@@ -57,7 +57,16 @@ def _solve_impl(qp: CanonicalQP,
                 y0: Optional[jax.Array],
                 l1_weight: Optional[jax.Array] = None,
                 l1_center: Optional[jax.Array] = None) -> QPSolution:
-    scaled, scaling = equilibrate(qp, iters=params.scaling_iters)
+    if params.scaling_mode == "factored":
+        scaled, scaling = equilibrate_factored(qp)
+    elif params.scaling_mode == "ruiz":
+        scaled, scaling = equilibrate(qp, iters=params.scaling_iters)
+    else:
+        # A typo'd mode silently measuring the wrong equilibration
+        # would poison promotion evidence — fail loudly instead.
+        raise ValueError(
+            f"unknown scaling_mode {params.scaling_mode!r}; "
+            "expected 'ruiz' or 'factored'")
 
     x0_s = None if x0 is None else x0 / scaling.D
     y0_s = None if y0 is None else scaling.c * y0 / jnp.where(scaling.E > 0, scaling.E, 1.0)

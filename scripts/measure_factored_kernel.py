@@ -29,7 +29,7 @@ Xs, ys = jnp.asarray(Xs_np), jnp.asarray(ys_np)
 
 for backend in ("xla", "pallas"):
     params = SolverParams(max_iter=2000, eps_abs=1e-3, eps_rel=1e-3,
-                          polish=False, scaling_iters=2,
+                          polish=False, scaling_mode="factored",
                           linsolve="woodbury", woodbury_refine=0,
                           check_interval=35, backend=backend,
                           vmem_limit_mb=64.0)

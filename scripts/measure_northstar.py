@@ -33,16 +33,23 @@ print(f"RESULT northstar B={B}: {per*1e3:.1f} ms = {per/B*1e6:.1f} us/date, "
       f"solved {solved}/{B}, "
       f"TE {float(jnp.median(out.tracking_error)):.4e}", flush=True)
 
-# The promoted TPU headline config (woodbury/capacitance segments).
+# The round-3 woodbury config and the round-4 headline candidate
+# (woodbury + factor-derived Jacobi scaling: no dense-P Ruiz sweeps).
+import dataclasses
+
 pwb = SolverParams(max_iter=2000, eps_abs=1e-3, eps_rel=1e-3,
                    polish=False, scaling_iters=2,
                    linsolve="woodbury", woodbury_refine=0,
                    check_interval=35)
-out3 = jax.jit(lambda X: tracking_step(X, ys, pwb))(Xs)
-solved3 = int(jnp.sum(out3.status == 1))
-per3 = measure_steady_state(
-    lambda X: jnp.sum(tracking_step(X, ys, pwb).tracking_error), Xs, k=3)
-print(f"RESULT northstar-woodbury B={B}: {per3*1e3:.1f} ms, "
-      f"solved {solved3}/{B}, "
-      f"iters {float(jnp.median(out3.iters)):.0f}/{int(jnp.max(out3.iters))}, "
-      f"TE {float(jnp.median(out3.tracking_error)):.4e}", flush=True)
+for tag, p in (("woodbury", pwb),
+               ("woodbury-facscale",
+                dataclasses.replace(pwb, scaling_mode="factored"))):
+    out3 = jax.jit(lambda X: tracking_step(X, ys, p))(Xs)
+    solved3 = int(jnp.sum(out3.status == 1))
+    per3 = measure_steady_state(
+        lambda X: jnp.sum(tracking_step(X, ys, p).tracking_error), Xs, k=3)
+    print(f"RESULT northstar-{tag} B={B}: {per3*1e3:.1f} ms, "
+          f"solved {solved3}/{B}, "
+          f"iters {float(jnp.median(out3.iters)):.0f}/"
+          f"{int(jnp.max(out3.iters))}, "
+          f"TE {float(jnp.median(out3.tracking_error)):.4e}", flush=True)

@@ -200,3 +200,60 @@ def test_polish_iteration_recovers_from_rejected_first_pass():
     assert abs(float(jnp.sum(x_u)) - 1.0) < 1e-5
     rp, rd, *_ = _residuals(scaled, scaling, *two, params)
     assert float(rp) < 1e-5
+
+
+class TestFactoredScaling:
+    """scaling_mode="factored" (round 4): Jacobi scaling from the
+    objective factor, no dense-P Ruiz sweeps — the TPU headline
+    config's scaling stage. Quality parity with Ruiz on the tracking
+    workload is the promotion contract."""
+
+    def test_factored_scaling_matches_ruiz_solution(self, rng):
+        import dataclasses
+
+        from porqua_tpu.tracking import build_tracking_qp
+
+        X = jnp.asarray(rng.standard_normal((96, 40)) * 0.01, jnp.float64)
+        y = jnp.asarray(np.asarray(X) @ (np.ones(40) / 40), jnp.float64)
+        qp = build_tracking_qp(X, y)
+        base = SolverParams(max_iter=4000, eps_abs=1e-9, eps_rel=1e-9,
+                            linsolve="woodbury", woodbury_refine=0)
+        fac = dataclasses.replace(base, scaling_mode="factored")
+        ref = solve_qp(qp, base)
+        got = solve_qp(qp, fac)
+        assert bool(got.found) and bool(ref.found)
+        np.testing.assert_allclose(np.asarray(got.x), np.asarray(ref.x),
+                                   atol=1e-7)
+
+    def test_factored_scaling_bench_shard_parity_f32(self, rng):
+        """The exact bench headline config at a north-star shard on the
+        suite's CPU backend: all solved, one clean segment, TE parity
+        with Ruiz x2 (the measurement quoted in bench.py)."""
+        import dataclasses
+
+        from porqua_tpu.tracking import synthetic_universe_np, tracking_step_jit
+
+        Xs_np, ys_np = synthetic_universe_np(seed=42, n_dates=8,
+                                             window=252, n_assets=500)
+        Xs, ys = jnp.asarray(Xs_np), jnp.asarray(ys_np)
+        wb = SolverParams(max_iter=2000, eps_abs=1e-3, eps_rel=1e-3,
+                          polish=False, scaling_iters=2,
+                          linsolve="woodbury", woodbury_refine=0,
+                          check_interval=35)
+        fac = dataclasses.replace(wb, scaling_mode="factored")
+        out_r = tracking_step_jit(Xs, ys, wb)
+        out_f = tracking_step_jit(Xs, ys, fac)
+        assert int(jnp.sum(out_f.status == 1)) == 8
+        # One clean segment: no straggler lanes under factored scaling.
+        assert int(jnp.max(out_f.iters)) == 35, np.asarray(out_f.iters)
+        np.testing.assert_allclose(
+            np.asarray(out_f.tracking_error),
+            np.asarray(out_r.tracking_error), rtol=2e-3)
+
+    def test_factored_scaling_requires_factor(self, rng):
+        qp = CanonicalQP.build(
+            P=np.eye(4), q=np.zeros(4), C=np.ones((1, 4)), l=np.ones(1),
+            u=np.ones(1), lb=np.zeros(4), ub=np.ones(4),
+            dtype=jnp.float64)
+        with pytest.raises(ValueError, match="factored"):
+            solve_qp(qp, SolverParams(scaling_mode="factored"))
