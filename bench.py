@@ -446,6 +446,7 @@ def device_child(platform: str, n_dates: int) -> None:
         N_ASSETS, 1, WINDOW, iters_med, n_dates,
         check_interval=params.check_interval,
         scaling_iters=params.scaling_iters,
+        scaling_mode=params.scaling_mode,
         pallas=False,
         polish_passes=params.polish_passes if params.polish else 0,
         # Count what actually ran — ask the solver's own dispatch rule

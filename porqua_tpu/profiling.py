@@ -273,6 +273,7 @@ def device_peaks(device_kind: str):
 def admm_flop_model(n: int, m: int, window: int, iters: float,
                     n_dates: int = 1, *, segments: Optional[float] = None,
                     check_interval: int = 25, scaling_iters: int = 10,
+                    scaling_mode: str = "ruiz",
                     pallas: bool = False, polish_passes: int = 3,
                     polish_refine_steps: int = 3,
                     l1_kkt_solves: int = 1,
@@ -291,16 +292,28 @@ def admm_flop_model(n: int, m: int, window: int, iters: float,
     ``n_dates`` at the end. ``iters`` is the average iteration count
     actually executed (device-reported).
     """
+    if scaling_mode not in ("ruiz", "factored"):
+        # Same contract as qp.solve: a typo'd mode silently counted as
+        # Ruiz would quote a wrong roofline with no error.
+        raise ValueError(f"unknown scaling_mode {scaling_mode!r}; "
+                         "expected 'ruiz' or 'factored'")
     T = window
     segs = (iters / check_interval) if segments is None else segments
     flops = {}
     flops["gram"] = 2.0 * T * n * n + 4.0 * T * n
-    flops["ruiz"] = scaling_iters * 4.0 * (m * n + n * n)
+    if scaling_mode == "factored":
+        # Jacobi diagonal from the factor (one Pf pass) + ONE fused
+        # scaled-P materialization (ruiz.equilibrate_factored).
+        flops["scaling"] = 2.0 * T * n + 2.0 * n * n
+    else:
+        flops["scaling"] = scaling_iters * 4.0 * (m * n + n * n)
     kcap = T + m  # capacitance dimension of the woodbury segment path
-    if linsolve == "woodbury" and not pallas:
+    if linsolve == "woodbury":
         # Capacitance factorization instead of the n x n KKT: S = I +
         # (V D^-1) V' assembly (2 k^2 n), chol(S) + its triangular
         # inverse (k^3/3 + k^3), and the W = L^-1 V D^-1 build (2 k^2 n).
+        # Identical for the XLA path and the factored Pallas segment —
+        # the kernel fuses only the iteration loop, the build stays XLA.
         fact = 4.0 * kcap * kcap * n + (kcap ** 3) / 3.0 + (kcap ** 3)
     else:
         fact = (n ** 3) / 3.0 + 2.0 * m * n * n  # chol + C'rhoC assembly
@@ -356,18 +369,29 @@ def admm_flop_model(n: int, m: int, window: int, iters: float,
     item = 4.0  # f32 bytes
     bytes_ = {}
     bytes_["gram"] = item * (T * n + n * n)
+    # Scaling traffic: each Ruiz sweep reads P three times (column
+    # norms, rescale, gamma) and writes it once; the factored mode
+    # reads Pf once and does a single fused P read+write.
+    if scaling_mode == "factored":
+        bytes_["scaling"] = item * (T * n + 2.0 * n * n)
+    else:
+        bytes_["scaling"] = scaling_iters * item * 4.0 * n * n
     # Factor/Kinv traffic: the XLA path re-reads the factor (n^2) twice
-    # per iteration (two triangular solves); the Pallas path reads the
-    # inverse once per segment (VMEM-resident across the segment); the
-    # woodbury path re-reads the skinny W (k x n) per apply.
-    if pallas:
-        bytes_["iterate"] = segs * item * (n * n + m * n)
-        bytes_["factorize"] = segs * item * 6.0 * n * n
-    elif linsolve == "woodbury":
-        bytes_["iterate"] = iters * item * (
-            2.0 * kcap * n * (1.0 + 2.0 * woodbury_refine) + 2 * m * n)
+    # per iteration (two triangular solves); the woodbury path re-reads
+    # the skinny W (k x n) per apply; a Pallas fused segment reads its
+    # resident operator ONCE per segment (dense: Kinv/L^-1 at n^2;
+    # factored: W + Y0 at ~k n + n m).
+    if linsolve == "woodbury":
+        if pallas:
+            bytes_["iterate"] = segs * item * (kcap * n + 2.0 * m * n)
+        else:
+            bytes_["iterate"] = iters * item * (
+                2.0 * kcap * n * (1.0 + 2.0 * woodbury_refine) + 2 * m * n)
         bytes_["factorize"] = segs * item * (4.0 * kcap * n
                                              + 3.0 * kcap * kcap)
+    elif pallas:
+        bytes_["iterate"] = segs * item * (n * n + m * n)
+        bytes_["factorize"] = segs * item * 6.0 * n * n
     else:
         bytes_["iterate"] = iters * item * 2.0 * (n * n) + iters * item * 2 * m * n
         bytes_["factorize"] = segs * item * 4.0 * n * n

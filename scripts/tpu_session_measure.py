@@ -1,5 +1,9 @@
 """Round-3 TPU measurement batch, probe-gated against tunnel flaps.
 
+[SUPERSEDED in round 4 by scripts/tpu_queue_r04.py + scripts/tpu_jobs/
+(directory-driven, jobs addable while live, process-group timeouts);
+kept for the round-3 provenance record.]
+
 The axon tunnel black-holes rather than failing fast, so a hung full
 measurement burns its whole timeout (25 min in the round-2 version of
 this script). Round 3 gates every attempt behind a cheap probe child

@@ -1,4 +1,6 @@
 #!/bin/bash
+# [SUPERSEDED in round 4 by scripts/tpu_queue_r04.py + scripts/tpu_jobs/ —
+#  kept for the round-3 provenance record.]
 # Round-3 chip-session queue: after the measurement batch exits, run the
 # remaining TPU jobs in priority order, each gated on a fresh probe so a
 # flapping tunnel costs a probe, not a full job timeout.

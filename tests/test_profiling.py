@@ -60,3 +60,27 @@ class TestSolveStats:
         assert stats["solved"] == 1
         assert stats["iters_max"] >= 1
         assert stats["prim_res_max"] < 1e-4
+
+
+def test_flop_model_scaling_and_kernel_modes():
+    """The analytic model must reflect what the configs actually do:
+    factored scaling sheds the Ruiz P sweeps, and the factored Pallas
+    segment sheds the per-iteration W re-reads (reads it once per
+    segment instead)."""
+    from porqua_tpu.profiling import admm_flop_model
+
+    kw = dict(n=500, m=1, window=252, iters=35.0, n_dates=252,
+              check_interval=35, scaling_iters=2, linsolve="woodbury",
+              woodbury_refine=0, polish_passes=0)
+    ruiz = admm_flop_model(**kw, scaling_mode="ruiz")
+    fac = admm_flop_model(**kw, scaling_mode="factored")
+    assert (fac["bytes_breakdown"]["scaling"]
+            < ruiz["bytes_breakdown"]["scaling"] / 2)
+
+    xla = admm_flop_model(**kw, scaling_mode="factored", pallas=False)
+    pal = admm_flop_model(**kw, scaling_mode="factored", pallas=True)
+    assert (pal["bytes_breakdown"]["iterate"]
+            < xla["bytes_breakdown"]["iterate"] / 5)
+    # The capacitance build is identical XLA work on both backends.
+    assert (pal["flops_breakdown"]["factorize"]
+            == xla["flops_breakdown"]["factorize"])
