@@ -299,12 +299,22 @@ def admm_flop_model(n: int, m: int, window: int, iters: float,
                          "expected 'ruiz' or 'factored'")
     T = window
     segs = (iters / check_interval) if segments is None else segments
+    # Every P consumer applies P through the factor
+    # (CanonicalQP.apply_P), so on the fully-factored pipeline —
+    # woodbury segments, factor-derived scaling, polish off — the dense
+    # P array is never read and XLA dead-code-eliminates the Gram build
+    # and the scaled-P materialization (verified: zero 500x500 dots in
+    # the compiled north-star program).
+    # The polish keeps the elision when it runs its factored path
+    # (polish_k set -> _kkt_solve_factored, which reads only Pf).
+    dense_p = not (linsolve == "woodbury" and scaling_mode == "factored"
+                   and (polish_passes == 0 or polish_k is not None))
     flops = {}
-    flops["gram"] = 2.0 * T * n * n + 4.0 * T * n
+    flops["gram"] = (2.0 * T * n * n if dense_p else 0.0) + 4.0 * T * n
     if scaling_mode == "factored":
-        # Jacobi diagonal from the factor (one Pf pass) + ONE fused
-        # scaled-P materialization (ruiz.equilibrate_factored).
-        flops["scaling"] = 2.0 * T * n + 2.0 * n * n
+        # Jacobi diagonal from the factor (one Pf pass) + (only when
+        # the dense P survives) ONE fused scaled-P materialization.
+        flops["scaling"] = 2.0 * T * n + (2.0 * n * n if dense_p else 0.0)
     else:
         flops["scaling"] = scaling_iters * 4.0 * (m * n + n * n)
     kcap = T + m  # capacitance dimension of the woodbury segment path
@@ -368,12 +378,14 @@ def admm_flop_model(n: int, m: int, window: int, iters: float,
 
     item = 4.0  # f32 bytes
     bytes_ = {}
-    bytes_["gram"] = item * (T * n + n * n)
+    bytes_["gram"] = item * (T * n + (n * n if dense_p else 0.0))
     # Scaling traffic: each Ruiz sweep reads P three times (column
     # norms, rescale, gamma) and writes it once; the factored mode
-    # reads Pf once and does a single fused P read+write.
+    # reads Pf once and (dense-P pipelines only) does a single fused
+    # P read+write.
     if scaling_mode == "factored":
-        bytes_["scaling"] = item * (T * n + 2.0 * n * n)
+        bytes_["scaling"] = item * (T * n
+                                    + (2.0 * n * n if dense_p else 0.0))
     else:
         bytes_["scaling"] = scaling_iters * item * 4.0 * n * n
     # Factor/Kinv traffic: the XLA path re-reads the factor (n^2) twice

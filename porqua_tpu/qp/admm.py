@@ -224,7 +224,11 @@ def _residuals(qp: CanonicalQP, scaling: Scaling, x, z, w, y, mu, params: Solver
     r_prim = jnp.maximum(
         _inf_norm(Einv * (Cx - z)), _inf_norm(scaling.D * (x - w))
     )
-    dual_vec = qp.P @ x + qp.q + qp.C.T @ y + mu
+    # P applied through the factor when present (qp.apply_P): keeps the
+    # dense P unread on the factored pipeline so XLA can eliminate its
+    # construction altogether.
+    Px = qp.apply_P(x)
+    dual_vec = Px + qp.q + qp.C.T @ y + mu
     r_dual = cinv * _inf_norm(Dinv * dual_vec)
 
     denom_p = jnp.max(jnp.array([
@@ -232,7 +236,7 @@ def _residuals(qp: CanonicalQP, scaling: Scaling, x, z, w, y, mu, params: Solver
         _inf_norm(scaling.D * x), _inf_norm(scaling.D * w),
     ]))
     denom_d = cinv * jnp.max(jnp.array([
-        _inf_norm(Dinv * (qp.P @ x)), _inf_norm(Dinv * (qp.C.T @ y)),
+        _inf_norm(Dinv * Px), _inf_norm(Dinv * (qp.C.T @ y)),
         _inf_norm(Dinv * qp.q), _inf_norm(Dinv * mu),
     ]))
 
@@ -276,7 +280,7 @@ def _infeasibility(qp: CanonicalQP, scaling: Scaling, dx, dy, dmu,
 
     # Dual infeasibility: P dx ~ 0, q'dx < 0, C dx in recession cone
     norm_dx = _inf_norm(dx_u)
-    Pdx = (1.0 / scaling.c) * (1.0 / scaling.D) * (qp.P @ dx)
+    Pdx = (1.0 / scaling.c) * (1.0 / scaling.D) * qp.apply_P(dx)
     qdx = (1.0 / scaling.c) * jnp.dot(qp.q, dx)
     if l1w is not None:
         # Unscaled L1 slope: sum_i w_i |D_i dx_i| = (1/c) sum_i l1w_i |dx_i|.

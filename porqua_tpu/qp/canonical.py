@@ -81,10 +81,34 @@ class CanonicalQP(NamedTuple):
     def batch_shape(self):
         return self.P.shape[:-2]
 
+    def apply_P(self, v):
+        """``P @ v`` through the factor when one is present.
+
+        With ``Pf`` the product is ``2 Pf'(Pf v) + Pdiag * v`` — two
+        skinny (r x n) matvecs instead of a dense n x n one, and (the
+        structural point) it leaves the dense ``P`` array UNREAD: in a
+        pipeline where every P consumer routes through here (residuals,
+        infeasibility certificates, objective/gap) XLA dead-code-
+        eliminates the Gram build and the scaled-P materialization
+        entirely — at the north-star batch that is ~32 of 75 GFLOP and
+        ~1 GB of HBM traffic (BASELINE.md round-4 roofline). The factor
+        form agrees with the dense product to rounding by the
+        ``P == 2 Pf'Pf + diag(Pdiag)`` build invariant.
+        """
+        if self.Pf is None:
+            return jnp.einsum("...ij,...j->...i", self.P, v)
+        hp = jax.lax.Precision.HIGHEST
+        t = jnp.einsum("...rj,...j->...r", self.Pf, v, precision=hp)
+        out = 2.0 * jnp.einsum("...rj,...r->...j", self.Pf, t, precision=hp)
+        if self.Pdiag is not None:
+            out = out + self.Pdiag * v
+        return out
+
     def objective_value(self, x, with_const: bool = True):
         """0.5 x'Px + q'x (+ constant); mirrors reference
-        ``qp_problems.py:219-221``."""
-        val = 0.5 * jnp.einsum("...i,...ij,...j->...", x, self.P, x) + jnp.einsum(
+        ``qp_problems.py:219-221``. P is applied through the factor
+        when present (see :meth:`apply_P`)."""
+        val = 0.5 * jnp.einsum("...i,...i->...", x, self.apply_P(x)) + jnp.einsum(
             "...i,...i->...", self.q, x
         )
         return val + self.constant if with_const else val

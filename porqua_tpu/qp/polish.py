@@ -75,10 +75,7 @@ def _kkt_solve_factored(qp: CanonicalQP, params: SolverParams,
     pd = jnp.zeros(n, dtype) if qp.Pdiag is None else qp.Pdiag
     Z = 1.0 - aB
     x_a = aB * bound_B
-
-    def apply_P(v):
-        Fv = jnp.dot(qp.Pf, v, precision=hp)
-        return 2.0 * jnp.dot(Fv, qp.Pf, precision=hp) + pd * v
+    apply_P = qp.apply_P  # the one canonical factor-product implementation
 
     Dt = aB + sigma + pd * Z
     V = jnp.sqrt(jnp.asarray(2.0, dtype)) * qp.Pf * Z[None, :]

@@ -117,7 +117,7 @@ def _solve_impl(qp: CanonicalQP,
     # computed against the original (unscaled) bounds.
     if l1_weight is None:
         gap = jnp.abs(
-            jnp.dot(x_u, qp.P @ x_u) + jnp.dot(qp.q, x_u)
+            jnp.dot(x_u, qp.apply_P(x_u)) + jnp.dot(qp.q, x_u)
             + _support(qp.u, qp.l, y_u) + _support(qp.ub, qp.lb, mu_u)
         )
     else:
@@ -137,7 +137,7 @@ def _solve_impl(qp: CanonicalQP,
         g = jnp.clip(mu_u, -l1_weight, l1_weight)
         mu_box = mu_u - g
         gap = jnp.abs(
-            jnp.dot(x_u, qp.P @ x_u) + jnp.dot(qp.q, x_u)
+            jnp.dot(x_u, qp.apply_P(x_u)) + jnp.dot(qp.q, x_u)
             + jnp.sum(l1_weight * jnp.abs(dx_c)) + jnp.dot(c_vec, g)
             + _support(qp.u, qp.l, y_u) + _support(qp.ub, qp.lb, mu_box)
         )
