@@ -257,3 +257,25 @@ class TestFactoredScaling:
             dtype=jnp.float64)
         with pytest.raises(ValueError, match="factored"):
             solve_qp(qp, SolverParams(scaling_mode="factored"))
+
+    def test_dense_p_elided_from_compiled_headline_program(self):
+        """Regression pin for the round-4 dense-P elision: the compiled
+        north-star program under the full headline config (woodbury +
+        factored scaling + polish off) must contain NO n x n dot —
+        a new dense-P consumer anywhere in the pipeline would silently
+        re-introduce the Gram build and ~1 GB of HBM traffic."""
+        from porqua_tpu.tracking import synthetic_universe_np, tracking_step
+
+        Xs_np, ys_np = synthetic_universe_np(seed=1, n_dates=2,
+                                             window=96, n_assets=160)
+        Xs, ys = jnp.asarray(Xs_np), jnp.asarray(ys_np)
+        fac = SolverParams(max_iter=2000, eps_abs=1e-3, eps_rel=1e-3,
+                           polish=False, linsolve="woodbury",
+                           woodbury_refine=0, check_interval=35,
+                           scaling_mode="factored")
+        hlo = (jax.jit(lambda X: tracking_step(X, ys, fac))
+               .lower(Xs).compile().as_text())
+        n = 160
+        bad = [ln for ln in hlo.splitlines()
+               if "dot(" in ln and f"{n},{n}" in ln.replace(" ", "")]
+        assert not bad, bad[:3]
