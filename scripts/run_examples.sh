@@ -1,0 +1,17 @@
+#!/bin/bash
+# Run every example end-to-end (CPU); print one status line per script.
+# Exit 1 if any example fails. Used by the build sessions as the
+# examples-level regression gate (the suite proper is run_tests.sh).
+cd "$(dirname "$0")/.."
+fail=0
+for f in examples/*.py; do
+  case "$f" in */_common.py) continue;; esac
+  if timeout 900 python "$f" > /tmp/example_out.log 2>&1; then
+    echo "OK   $f: $(tail -1 /tmp/example_out.log | head -c 120)"
+  else
+    echo "FAIL $f (rc=$?)"
+    tail -5 /tmp/example_out.log
+    fail=1
+  fi
+done
+exit $fail

@@ -1,0 +1,9 @@
+# TIMEOUT: 700
+# ATTEMPTS: 3
+# SUCCESS: RESULT northstar-woodbury-facscale B=252
+# The headline numbers (trinv, woodbury+ruiz2, woodbury+factored-scaling
+# with dense-P elision) at B=252 — the most decisive minutes of chip
+# time after the bench rehearsal; runs before the long hardware-test
+# suite so a short window still captures them.
+python scripts/measure_northstar.py 252 2>&1 | tee .tpu_queue/northstar_252.log
+exit ${PIPESTATUS[0]}
