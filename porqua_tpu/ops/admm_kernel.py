@@ -186,20 +186,24 @@ def _segment_kernel(Kinv_ref, C_ref, q_ref, l_ref, u_ref, lb_ref, ub_ref,
                  dx_out, dy_out, dmu_out)
 
 
-def _factored_segment_kernel(W_ref, invd_ref, Y0_ref, Ginv_ref,
+def _factored_segment_kernel(W_ref, invd_ref, Y0_ref, Ginv_ref, V_ref,
+                             Dv_ref,
                              C_ref, q_ref, l_ref, u_ref, lb_ref, ub_ref,
                              rho_ref, rhob_ref, l1w_ref, l1c_ref,
                              x_ref, z_ref, w_ref, y_ref, mu_ref,
                              x_out, z_out, w_out, y_out, mu_out,
                              dx_out, dy_out, dmu_out,
-                             *, sigma: float, alpha: float, n_iters: int):
+                             *, sigma: float, alpha: float, n_iters: int,
+                             refine_steps: int = 0):
     """Factored (capacitance/Woodbury) segment: resident state is
     ``W`` (k x n), ``inv_d`` (n), ``Y0`` (n x m), ``Ginv`` (m x m) —
     the exact operator pieces of the XLA ``linsolve="woodbury"`` path
     (``qp/admm.py``: ``factored_solve_pieces`` + the eq-row Schur
-    split), with the raw refine=0 apply:
+    split):
 
-        x0 = inv_d * rhs - (rhs W') W
+        base(r) = inv_d * r - (r W') W      (+ refine_steps rounds of
+                  iterative refinement against K = diag(Dv) + V'V,
+                  which additionally keeps V and Dv resident)
         xt = x0 - (Ginv (C x0)) Y0'
     """
     dtype = x_ref.dtype
@@ -208,11 +212,22 @@ def _factored_segment_kernel(W_ref, invd_ref, Y0_ref, Ginv_ref,
     Y0 = Y0_ref[:]
     Ginv = Ginv_ref[:]
     C = C_ref[:]
+    if refine_steps:
+        V = V_ref[:]
+        Dv = Dv_ref[:]
+
+    def base(r):
+        t = _row_dot_t(r, W, dtype)               # (1, k) = r @ W'
+        return r * inv_d - jnp.dot(
+            t, W, preferred_element_type=dtype, precision=_HP)
 
     def solve_fn(rhs):
-        t = _row_dot_t(rhs, W, dtype)             # (1, k) = rhs @ W'
-        x0 = rhs * inv_d - jnp.dot(
-            t, W, preferred_element_type=dtype, precision=_HP)
+        x0 = base(rhs)
+        for _ in range(refine_steps):
+            Kx = Dv * x0 + jnp.dot(
+                _row_dot_t(x0, V, dtype), V,
+                preferred_element_type=dtype, precision=_HP)
+            x0 = x0 + base(rhs - Kx)
         s = _row_dot_t(x0, C, dtype)              # (1, m) = C @ x0
         # G is symmetric (diag(1/rho) + C K0^-1 C'), hence so is Ginv:
         # row-vector application s @ Ginv == (Ginv s)'.
@@ -319,12 +334,15 @@ def admm_segment(Kinv: jax.Array,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("sigma", "alpha", "n_iters", "interpret"),
+    static_argnames=("sigma", "alpha", "n_iters", "interpret",
+                     "refine_steps"),
 )
 def admm_segment_factored(W: jax.Array,
                           inv_d: jax.Array,
                           Y0: jax.Array,
                           Ginv: jax.Array,
+                          V: jax.Array,
+                          Dv: jax.Array,
                           C: jax.Array,
                           q: jax.Array,
                           l: jax.Array,
@@ -344,15 +362,21 @@ def admm_segment_factored(W: jax.Array,
                           sigma: float,
                           alpha: float,
                           n_iters: int,
-                          interpret: bool = False) -> Tuple[jax.Array, ...]:
+                          interpret: bool = False,
+                          refine_steps: int = 0) -> Tuple[jax.Array, ...]:
     """Run ``n_iters`` fused factored-operator ADMM iterations on one
-    problem (capacitance/Woodbury form, refine=0).
+    problem (capacitance/Woodbury form).
 
     ``W`` (k x n), ``inv_d`` (n), ``Y0`` (n x m), ``Ginv`` (m x m) are
     the per-segment operator pieces the XLA woodbury path builds
     (``qp/admm.py:segment``); the build stays in XLA — this kernel
     fuses only the iteration loop, which is where the HBM traffic is.
-    Batching is ``jax.vmap`` exactly as for :func:`admm_segment`.
+    With ``refine_steps > 0`` the factor ``V`` (k x n) and diagonal
+    ``Dv`` also stay resident for the in-kernel iterative refinement
+    (the library-default refine=1 accuracy mode); at refine_steps=0
+    they are replaced by tile-sized placeholders the kernel never
+    reads. Batching is ``jax.vmap`` exactly as for
+    :func:`admm_segment`.
 
     Padding: k, n, m each round up to lane multiples of 128. Padded W
     rows/cols and Y0 entries are zero, padded ``Ginv`` carries a unit
@@ -381,8 +405,19 @@ def admm_segment_factored(W: jax.Array,
     Y0_p = jnp.zeros((n_p, m_p), dtype).at[:n, :m].set(Y0)
     Ginv_p = jnp.eye(m_p, dtype=dtype).at[:m, :m].set(Ginv)
     C_p = jnp.zeros((m_p, n_p), dtype).at[:m, :n].set(C)
+    if refine_steps:
+        # Padded V columns are zero and padded Dv entries 1.0, so the
+        # refinement residual of a padded (fixed-at-zero) variable is
+        # exactly zero — padding neutrality as for the rest.
+        V_p = jnp.zeros((k_p, n_p), dtype).at[:k, :n].set(V)
+        Dv_p = pad_vec(Dv, n_p, 1.0)
+    else:
+        # Never read by the kernel (static refine_steps gate); keep
+        # one tile so VMEM is not spent on a dead (k x n) array.
+        V_p = jnp.zeros((8, 128), dtype)
+        Dv_p = jnp.zeros((1, 128), dtype)
     args = (
-        W_p, pad_vec(inv_d, n_p, 1.0), Y0_p, Ginv_p, C_p,
+        W_p, pad_vec(inv_d, n_p, 1.0), Y0_p, Ginv_p, V_p, Dv_p, C_p,
         pad_vec(q, n_p),
         pad_vec(l, m_p, -inf), pad_vec(u, m_p, inf),
         pad_vec(lb, n_p), pad_vec(ub, n_p),
@@ -397,7 +432,7 @@ def admm_segment_factored(W: jax.Array,
     out = pl.pallas_call(
         functools.partial(
             _factored_segment_kernel, sigma=sigma, alpha=alpha,
-            n_iters=n_iters,
+            n_iters=n_iters, refine_steps=refine_steps,
         ),
         out_shape=(vec_n, vec_m, vec_n, vec_m, vec_n, vec_n, vec_m, vec_n),
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * len(args),

@@ -395,7 +395,10 @@ def admm_flop_model(n: int, m: int, window: int, iters: float,
     # factored: W + Y0 at ~k n + n m).
     if linsolve == "woodbury":
         if pallas:
-            bytes_["iterate"] = segs * item * (kcap * n + 2.0 * m * n)
+            # Resident set read once per segment: W, plus V when the
+            # in-kernel refinement is on.
+            resident = kcap * n * (2.0 if woodbury_refine else 1.0)
+            bytes_["iterate"] = segs * item * (resident + 2.0 * m * n)
         else:
             bytes_["iterate"] = iters * item * (
                 2.0 * kcap * n * (1.0 + 2.0 * woodbury_refine) + 2 * m * n)

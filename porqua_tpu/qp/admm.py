@@ -328,11 +328,6 @@ def resolve_linsolve(params: SolverParams, qp: CanonicalQP) -> str:
             raise ValueError(
                 "linsolve='woodbury' requires the factored objective "
                 "(qp.Pf with P = 2 Pf'Pf + diag(Pdiag))")
-        if params.backend == "pallas" and params.woodbury_refine != 0:
-            raise ValueError(
-                "the fused Pallas factored segment implements the raw "
-                "(refine=0) capacitance apply; set woodbury_refine=0 "
-                "or backend='xla'")
         return "woodbury"
     if ls == "auto":
         if jnp.dtype(qp.P.dtype) == jnp.float32:
@@ -545,8 +540,10 @@ def admm_solve(qp: CanonicalQP,
     m_pad = ((max(m, 1) + 127) // 128) * 128
     if linsolve == "woodbury":
         k_pad = ((max(qp.Pf.shape[-2], 1) + 127) // 128) * 128
+        # refine >= 1 additionally keeps the factor V resident.
+        n_kxn = 2 if params.woodbury_refine else 1
         vmem_bytes = (
-            (k_pad * n_pad + 2 * m_pad * n_pad + m_pad * m_pad
+            (n_kxn * k_pad * n_pad + 2 * m_pad * n_pad + m_pad * m_pad
              + 16 * (n_pad + m_pad + k_pad))
             * jnp.dtype(dtype).itemsize
         )
@@ -679,14 +676,16 @@ def admm_solve(qp: CanonicalQP,
         if use_pallas:
             # Fused segment with the linear-solve operator VMEM-resident
             # across the whole check_interval (see
-            # porqua_tpu.ops.admm_kernel). With linsolve="woodbury"
-            # (refine=0) the resident state is the capacitance pieces
-            # (W, inv_d, Y0, Ginv) — ~((T+m) x n) instead of n x n, so
-            # this form fits VMEM in the regimes where the dense kernel
-            # OOMs, and saves the XLA path's two W re-reads per
-            # iteration. With linsolve="trinv" the resident matrix is
-            # L^-1 applied twice — the same accuracy story as the XLA
-            # trinv path; otherwise the refined explicit K^-1 once.
+            # porqua_tpu.ops.admm_kernel). With linsolve="woodbury" the
+            # resident state is the capacitance pieces (W, inv_d, Y0,
+            # Ginv; refine>=1 additionally keeps the factor V and Dv
+            # for in-kernel iterative refinement) — ~((T+m) x n)
+            # instead of n x n, so this form fits VMEM in the regimes
+            # where the dense kernel OOMs, and saves the XLA path's two
+            # W re-reads per iteration. With linsolve="trinv" the
+            # resident matrix is L^-1 applied twice — the same accuracy
+            # story as the XLA trinv path; otherwise the refined
+            # explicit K^-1 once.
             from porqua_tpu.ops.admm_kernel import (admm_segment,
                                                     admm_segment_factored)
 
@@ -697,12 +696,13 @@ def admm_solve(qp: CanonicalQP,
                 # serves the explicit-inverse error is negligible.
                 Ginv = jnp.linalg.inv(G)
                 x, z, w, y, mu, dx, dy, dmu = admm_segment_factored(
-                    W_w, inv_d_w, Y0, Ginv, qp.C, qp.q, qp.l, qp.u, qp.lb,
-                    qp.ub, rho, rho_b, l1w, l1c,
+                    W_w, inv_d_w, Y0, Ginv, V, Dv, qp.C, qp.q, qp.l,
+                    qp.u, qp.lb, qp.ub, rho, rho_b, l1w, l1c,
                     state.x, state.z, state.w, state.y, state.mu,
                     sigma=params.sigma, alpha=params.alpha,
                     n_iters=params.check_interval,
                     interpret=jax.default_backend() != "tpu",
+                    refine_steps=params.woodbury_refine,
                 )
             else:
                 if linsolve == "trinv":

@@ -228,9 +228,17 @@ class TestFactoredKernel:
         np.testing.assert_allclose(
             np.asarray(pal.x), np.asarray(ref.x), atol=5e-6)
 
-    def test_factored_kernel_requires_refine0(self, rng):
-        qp = self._tracking_qp(rng, T=24, n=8)
-        with pytest.raises(ValueError, match="refine"):
-            solve_qp(qp, SolverParams(backend="pallas",
-                                      linsolve="woodbury",
-                                      woodbury_refine=1))
+    def test_factored_kernel_refine1_matches_xla(self, rng):
+        """The library-default accuracy mode (woodbury_refine=1): the
+        in-kernel iterative refinement (V, Dv resident) must reproduce
+        the XLA path's refined apply exactly."""
+        qp = self._tracking_qp(rng, T=40, n=16)
+        kw = dict(linsolve="woodbury", woodbury_refine=1,
+                  eps_abs=1e-8, eps_rel=1e-8, max_iter=20000)
+        ref = solve_qp(qp, SolverParams(backend="xla", **kw))
+        pal = solve_qp(qp, SolverParams(backend="pallas", **kw))
+        assert bool(pal.found)
+        np.testing.assert_allclose(
+            np.asarray(pal.x), np.asarray(ref.x), atol=1e-9)
+        np.testing.assert_array_equal(
+            np.asarray(pal.iters), np.asarray(ref.iters))
