@@ -138,3 +138,65 @@ class TestFailureIsolation:
         status = np.asarray(sols.status)
         assert status[0] == Status.SOLVED
         assert status[1] in (Status.PRIMAL_INFEASIBLE, Status.MAX_ITER)
+
+
+@settings(deadline=None, max_examples=12)
+@given(seed=st.integers(0, 2**31 - 1), n=st.integers(6, 28),
+       extra=st.integers(4, 24))
+def test_factored_scaling_solution_parity_property(seed, n, extra):
+    """Property (round 4): for any OVERDETERMINED factored tracking
+    problem (T > n, so the optimum is unique — an underdetermined
+    window has a whole optimal face where two exact solvers may
+    legitimately land apart), the factor-derived Jacobi scaling must
+    land on the same optimum as Ruiz equilibration — the two modes
+    differ only by the diagonal change of variables, which the unscale
+    undoes exactly."""
+    import dataclasses
+
+    from porqua_tpu.tracking import build_tracking_qp
+
+    T = n + extra
+    rng = np.random.default_rng(seed)
+    X = jnp.asarray(rng.standard_normal((T, n)) * 0.01, jnp.float64)
+    y = jnp.asarray(
+        np.asarray(X) @ rng.dirichlet(np.ones(n))
+        + 0.001 * rng.standard_normal(T), jnp.float64)
+    qp = build_tracking_qp(X, y)
+    base = SolverParams(max_iter=8000, eps_abs=1e-9, eps_rel=1e-9,
+                        linsolve="woodbury", woodbury_refine=1)
+    ref = solve_qp(qp, base)
+    got = solve_qp(qp, dataclasses.replace(base, scaling_mode="factored"))
+    assert bool(ref.found) and bool(got.found)
+    np.testing.assert_allclose(np.asarray(got.x), np.asarray(ref.x),
+                               atol=5e-7)
+
+
+def test_scan_l1_accepts_headline_config():
+    """The turnover-coupled scan engine must run under the full TPU
+    headline config (woodbury + factored scaling) and agree with the
+    default-config chain — the scan carries warm starts and L1 centers
+    across dates, which must survive both code paths."""
+    import dataclasses
+
+    import jax
+
+    from porqua_tpu.batch import FIXED_UNIVERSE, solve_scan_l1
+    from porqua_tpu.tracking import build_tracking_qp, synthetic_universe
+
+    Xs, ys = synthetic_universe(jax.random.PRNGKey(3), n_dates=5,
+                                window=40, n_assets=16,
+                                dtype=jnp.float64)
+    qps = jax.vmap(build_tracking_qp)(Xs, ys)
+    w0 = jnp.full((16,), 1.0 / 16, jnp.float64)
+    base = SolverParams(max_iter=8000, eps_abs=1e-9, eps_rel=1e-9)
+    head = dataclasses.replace(base, linsolve="woodbury",
+                               woodbury_refine=1,
+                               scaling_mode="factored")
+    ref = solve_scan_l1(qps, 16, w0, 0.002, base,
+                        universes=FIXED_UNIVERSE)
+    got = solve_scan_l1(qps, 16, w0, 0.002, head,
+                        universes=FIXED_UNIVERSE)
+    assert np.all(np.asarray(ref.status) == 1)
+    assert np.all(np.asarray(got.status) == 1)
+    np.testing.assert_allclose(np.asarray(got.x), np.asarray(ref.x),
+                               atol=5e-7)
