@@ -112,6 +112,17 @@ def main():
     for si in (2, 1, 0):
         step_cfg(f"step woodbury ruiz{si}", polish=False, scaling_iters=si,
                  linsolve="woodbury", woodbury_refine=0, check_interval=35)
+    # Round-4 rows: the promoted headline config (factor-derived Jacobi
+    # scaling + dense-P elision) and the fused factored Pallas segment
+    # on top of it — together they shed the scaling and iterate byte
+    # lines (analytic: 12.1 GB -> 1.1 GB, BASELINE.md round-4 table).
+    step_cfg("step woodbury facscale", polish=False,
+             scaling_mode="factored", linsolve="woodbury",
+             woodbury_refine=0, check_interval=35)
+    step_cfg("step wb facscale pallas", polish=False,
+             scaling_mode="factored", linsolve="woodbury",
+             woodbury_refine=0, check_interval=35, backend="pallas",
+             vmem_limit_mb=64.0)
 
 
 def _blocked_trinv_stage(L):
