@@ -409,12 +409,14 @@ def run_batch(bs: BacktestService,
     for date-independent strategies, but every date solves concurrently
     in one XLA program.
     """
-    # Default to the strategy's OWN solver configuration, like the
-    # serial engine does — strategies inject problem-class-appropriate
-    # settings (LAD: fixed LP step size; the old SolverParams() default
-    # silently discarded them in batch mode).
-    params = (bs.optimization.params.to_solver_params()
-              if params is None else params)
+    # Build the problems FIRST, then default to the strategy's OWN
+    # resolved solver configuration, like the serial engine does.
+    # solver_params() is lowering-aware (LAD merges its fixed-LP-step
+    # overlay iff the prox form is the active lowering) and pure, but
+    # deriving it after the build keeps this robust to any future
+    # lowering that is decided during canonical_parts.
     problems = build_problems(bs, dtype=dtype)
+    if params is None:
+        params = bs.optimization.solver_params()
     solution = solve_batch(problems, params)
     return assemble_backtest(problems, solution)

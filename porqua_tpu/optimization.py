@@ -97,6 +97,14 @@ class Optimization(ABC):
     # model_qpsolvers, reference optimization.py:77-143)
     # ------------------------------------------------------------------
 
+    def solver_params(self) -> SolverParams:
+        """Resolved solver configuration for this strategy's active
+        lowering. Pure: consults but never mutates ``self.params``, so
+        callers may derive it before or after ``canonical_parts`` and
+        see the same answer. Subclasses with lowering-dependent solver
+        defaults (LAD's prox-form LP settings) merge them here."""
+        return self.params.to_solver_params()
+
     def solve_jax(self) -> None:
         name = self.params.get("solver_name", "jax_admm")
         if name not in (None, "", "jax_admm", "default"):
@@ -107,7 +115,7 @@ class Optimization(ABC):
             self._solve_via_backend(name)
             return
         qp = self.model_canonical()
-        solver_params = self.params.to_solver_params()
+        solver_params = self.solver_params()
 
         x0 = self._x_init_array()
         if x0 is not None and x0.shape[0] != qp.n:
@@ -184,7 +192,7 @@ class Optimization(ABC):
             raise ValueError(
                 f"solver {name!r} (backend key {key!r}) is not available "
                 f"in this environment; have {sorted(backends)}")
-        x, y, mu, found = backends[key](parts, self.params.to_solver_params())
+        x, y, mu, found = backends[key](parts, self.solver_params())
 
         if not found and self.params.get("allow_suboptimal"):
             # The backend contract reports only found/not-found; unlike
@@ -497,25 +505,6 @@ class LAD(Optimization):
             self.params["allow_suboptimal"] = True
         if "prox_form" not in self.params:
             self.params["prox_form"] = True
-        self._injected_lp_defaults = []
-        if self.params["prox_form"]:
-            # LP-appropriate solver defaults, only where the caller did
-            # not say otherwise. First-order ADMM on a pure LP needs a
-            # FIXED, larger step size: the residual-balancing adaptive
-            # rho drives a wander that never converges (measured on the
-            # production shape: +13% objective gap and worsening with
-            # more iterations under adaptive rho, vs solved at +4e-4
-            # with rho0=30 fixed — scripts/lad_scale_experiment.py).
-            # The injected keys are recorded so an epigraph fallback at
-            # lowering time (leverage constraint / external backend)
-            # can withdraw them — they were measured on the prox form
-            # only.
-            for k, v in (("adaptive_rho", False), ("rho0", 30.0),
-                         ("max_iter", 40000), ("eps_abs", 1e-5),
-                         ("eps_rel", 1e-5)):
-                if k not in self.params:
-                    self.params[k] = v
-                    self._injected_lp_defaults.append(k)
 
     def set_objective(self, optimization_data: OptimizationData) -> None:
         X = optimization_data["return_series"]
@@ -544,19 +533,43 @@ class LAD(Optimization):
             and name in (None, "", "jax_admm", "default")
             and "leverage" not in self.constraints.l1)
 
-    def _drop_injected_lp_defaults(self) -> None:
-        """Withdraw the prox-form solver defaults when lowering falls
-        back to the epigraph — fixed rho=30 was measured on the prox
-        form only, and the epigraph keeps its pre-round-4 behavior."""
-        for k in self._injected_lp_defaults:
-            self.params.pop(k, None)
-        self._injected_lp_defaults = []
+    # LP-appropriate solver defaults for the prox lowering, applied in
+    # solver_params() only where the caller did not say otherwise.
+    # First-order ADMM on a pure LP needs a FIXED, larger step size:
+    # the residual-balancing adaptive rho drives a wander that never
+    # converges (measured on the production shape: +13% objective gap
+    # and worsening with more iterations under adaptive rho, vs solved
+    # with rho fixed — scripts/lad_scale_experiment.py). Round 5 adds
+    # restarted Halpern anchoring (qp/admm.py SolverParams.halpern):
+    # measured at the production shape (N=500, T=252, f64,
+    # scripts/lad_accel_sweep.py + lad_scale_experiment.py), the
+    # round-4 fixed-rho config took 16,125 iterations to a +4.3e-4
+    # objective gap vs the f64 IPM oracle; halpern + alpha 1.8 +
+    # rho0 60 + a 200-iteration restart window solves in 4,200
+    # iterations at +2.4e-4 — 3.8x fewer at better quality.
+    # These were measured on the prox form ONLY, so they live in an
+    # overlay consulted iff the prox form is the active lowering —
+    # never written into self.params, so an epigraph fallback (leverage
+    # constraint / external backend) keeps its pre-round-4 behavior
+    # regardless of whether params are derived before or after
+    # canonical_parts.
+    _LP_PROX_DEFAULTS = {"adaptive_rho": False, "rho0": 60.0,
+                         "halpern": True, "alpha": 1.8,
+                         "check_interval": 200,
+                         "max_iter": 40000, "eps_abs": 1e-5,
+                         "eps_rel": 1e-5}
+
+    def solver_params(self) -> SolverParams:
+        if not self._wants_prox():
+            return self.params.to_solver_params()
+        fields = {k: v for k, v in self._LP_PROX_DEFAULTS.items()
+                  if k not in self.params}
+        fields.update({k: self.params[k] for k in _SOLVER_KEYS
+                       if k in self.params})
+        return SolverParams(**fields)
 
     def canonical_parts(self) -> dict:
-        if self._wants_prox():
-            return self._prox_parts()
-        self._drop_injected_lp_defaults()
-        return self._epigraph_parts()
+        return self._prox_parts() if self._wants_prox() else self._epigraph_parts()
 
     def _prox_parts(self) -> dict:
         """Native residual-prox lowering: variables [w, s], rows

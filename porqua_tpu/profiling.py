@@ -396,8 +396,12 @@ def admm_flop_model(n: int, m: int, window: int, iters: float,
     if linsolve == "woodbury":
         if pallas:
             # Resident set read once per segment: W, plus V when the
-            # in-kernel refinement is on.
-            resident = kcap * n * (2.0 if woodbury_refine else 1.0)
+            # in-kernel refinement is on, plus the constraint-side
+            # residents Y0 (n x m) and Ginv (m x m) — negligible at
+            # the m=1 headline shape but real traffic for
+            # constraint-heavy problems quoted through this roofline.
+            resident = (kcap * n * (2.0 if woodbury_refine else 1.0)
+                        + n * m + m * m)
             bytes_["iterate"] = segs * item * (resident + 2.0 * m * n)
         else:
             bytes_["iterate"] = iters * item * (

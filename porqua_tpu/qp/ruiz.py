@@ -140,15 +140,25 @@ def equilibrate_factored(qp: CanonicalQP) -> Tuple[CanonicalQP, Scaling]:
     diagP = 2.0 * jnp.sum(qp.Pf * qp.Pf, axis=-2)
     if qp.Pdiag is not None:
         diagP = diagP + qp.Pdiag
-    # Masked/padded columns carry a zero diagonal; scale them by 1.
-    D = jnp.where(diagP > 1e-12, 1.0 / jnp.sqrt(jnp.maximum(diagP, 1e-12)),
-                  1.0)
+    # Masked/padded columns carry an EXACTLY-zero diagonal (zero Pf
+    # columns, zero Pdiag), so > 0 is the precise live/padded cut —
+    # no magnitude floor at all. This keeps a uniformly tiny-scaled
+    # objective equilibrating (every positive P_jj scales, however
+    # small), without a relative cut's failure mode of misclassifying
+    # live-but-small columns as padding on wide-dynamic-range
+    # problems. ``tiny`` only guards the division in the branch not
+    # taken.
+    tiny = jnp.asarray(jnp.finfo(diagP.dtype).tiny, diagP.dtype)
+    D = jnp.where(diagP > 0,
+                  1.0 / jnp.sqrt(jnp.maximum(diagP, tiny)), 1.0)
 
     # Constraint rows: one pass over C (m x n), Ruiz-style row norms of
-    # the column-scaled matrix.
+    # the column-scaled matrix. Same exact-zero cut: only genuinely
+    # empty (padded) rows stay unscaled.
     if m:
         row_norm = jnp.max(jnp.abs(qp.C) * D[None, :], axis=1)
-        E = jnp.where(row_norm > 1e-8, 1.0 / row_norm, 1.0)
+        E = jnp.where(row_norm > 0,
+                      1.0 / jnp.maximum(row_norm, tiny), 1.0)
     else:
         E = jnp.ones((0,), dtype)
 

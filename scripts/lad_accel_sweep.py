@@ -1,0 +1,109 @@
+"""Sweep Halpern/step-size configurations for the LAD prox lowering.
+
+Round-5 verdict item 4: the round-4 prox form converges (+4e-4 vs the
+IPM oracle at N=500, T=252) but takes 16,125 iterations. This sweep
+measures restarted Halpern anchoring (qp/admm.py, SolverParams.halpern
+— the HPR-LP recipe) and step-size variants against the round-4
+baseline, reporting iterations + objective gap vs the f64 IPM oracle.
+
+Env: LAD_N, LAD_T, LAD_DTYPE (as lad_scale_experiment.py), LAD_QUICK=1
+to run the shortlist only.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+_env_plat = os.environ.get("JAX_PLATFORMS")
+if _env_plat and "axon" not in _env_plat:
+    jax.config.update("jax_platforms", _env_plat)
+
+import numpy as np
+
+N = int(os.environ.get("LAD_N", 250))
+T = int(os.environ.get("LAD_T", 126))
+DTYPE = os.environ.get("LAD_DTYPE", "float64")
+if DTYPE == "float64":
+    jax.config.update("jax_enable_x64", True)
+
+
+def build_lad(extra):
+    import jax.numpy as jnp
+
+    from porqua_tpu.constraints import Constraints
+    from porqua_tpu.optimization import LAD
+    from porqua_tpu.tracking import synthetic_universe_np
+
+    Xs, ys = synthetic_universe_np(seed=11, n_dates=1, window=T, n_assets=N)
+    X, y = Xs[0].astype(np.float64), ys[0].astype(np.float64)
+    lad = LAD(dtype=getattr(jnp, DTYPE), **extra)
+    cons = Constraints(selection=[f"a{i}" for i in range(N)])
+    cons.add_budget()
+    cons.add_box(lower=0.0, upper=1.0)
+    lad.constraints = cons
+    lad.objective = {"X": X, "y": y}
+    return lad, X, y
+
+
+def main():
+    from porqua_tpu.qp.ipm import solve_ipm
+
+    lad0, X, y = build_lad({"prox_form": False})
+    t0 = time.perf_counter()
+    ipm = solve_ipm(lad0.canonical_parts(), tol=1e-9)
+    t_ipm = time.perf_counter() - t0
+    w_ipm = np.asarray(ipm.x)[:N]
+    obj_ipm = float(np.sum(np.abs(X @ w_ipm - y)))
+    print(f"N={N} T={T} IPM oracle: {t_ipm:.1f}s obj {obj_ipm:.8f}",
+          flush=True)
+
+    # Every row pins its full config explicitly: `{}` would inherit
+    # the LAD overlay (_LP_PROX_DEFAULTS), which round 5 changed to
+    # the winning halpern config — an unpinned "baseline" row would
+    # silently measure the new default.
+    configs = [
+        ("r4 baseline a1.6 ci25 rho30",
+         {"halpern": False, "alpha": 1.6, "check_interval": 25,
+          "rho0": 30.0}),
+        ("r5 default (overlay)", {}),
+        ("halpern a1.6 ci100 rho30",
+         {"halpern": True, "alpha": 1.6, "check_interval": 100,
+          "rho0": 30.0}),
+        ("halpern a1.6 ci200 rho30",
+         {"halpern": True, "alpha": 1.6, "check_interval": 200,
+          "rho0": 30.0}),
+        ("halpern a1.6 ci400 rho30",
+         {"halpern": True, "alpha": 1.6, "check_interval": 400,
+          "rho0": 30.0}),
+        ("halpern a1.8 ci200 rho30",
+         {"halpern": True, "alpha": 1.8, "check_interval": 200,
+          "rho0": 30.0}),
+        ("halpern a1.6 ci200 rho10",
+         {"halpern": True, "alpha": 1.6, "check_interval": 200,
+          "rho0": 10.0}),
+        ("halpern a1.6 ci200 rho60",
+         {"halpern": True, "alpha": 1.6, "check_interval": 200,
+          "rho0": 60.0}),
+    ]
+    if os.environ.get("LAD_QUICK"):
+        configs = configs[:3]
+
+    for label, extra in configs:
+        lad, _, _ = build_lad(extra)
+        t0 = time.perf_counter()
+        ok = lad.solve()
+        t_solve = time.perf_counter() - t0
+        sol = lad.solution
+        w = np.asarray(sol.x)[:N]
+        obj = float(np.sum(np.abs(X @ w - y)))
+        gap = (obj - obj_ipm) / max(abs(obj_ipm), 1e-12)
+        print(f"RESULT {label}: ok={ok} iters {int(sol.iters)}, "
+              f"{t_solve:.1f}s (cold), obj {obj:.8f} (rel gap {gap:+.2e}), "
+              f"sum w {np.sum(w):.2e}, min w {np.min(w):.2e}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -116,8 +116,16 @@ def main():
     from porqua_tpu.constraints import Constraints
     from porqua_tpu.optimization import LAD
 
-    for label, extra in [("prox rho30 (LAD default)", {}),
-                         ("prox rho10", {"rho0": 10.0})]:
+    # {} = the LAD overlay default (round 5: halpern + alpha 1.8 +
+    # rho0 60 + 200-iteration restart window); the second row
+    # reproduces the round-4 fixed-rho config exactly for the
+    # before/after on one stream.
+    for label, extra in [
+        ("prox halpern (LAD default)", {}),
+        ("prox rho30 fixed (r4 config)",
+         {"halpern": False, "alpha": 1.6, "check_interval": 25,
+          "rho0": 30.0}),
+    ]:
         lad = LAD(dtype=getattr(jnp, DTYPE), **extra)
         cons = Constraints(selection=[f"a{i}" for i in range(N)])
         cons.add_budget()

@@ -64,6 +64,13 @@ def test_forced_cpu_run_prints_valid_json():
     assert payload["device_solved"] == 3
     assert payload["baseline_median_te"] > 0
     assert payload["device_median_te"] > 0
+    # Round-5 contract: the fallback artifact is interpretable at full
+    # size on its own — steady-state field plus a labeled linear
+    # extrapolation of the reduced shard.
+    assert payload["seconds_steady_state"] > 0
+    assert payload["value_full_extrapolated"] >= payload["value"]
+    assert "extrapolation" in payload
+    assert payload["vs_baseline_full_extrapolated"] > 0
 
 
 @pytest.mark.slow

@@ -318,7 +318,14 @@ def test_lad_prox_form_matches_ipm_objective():
         return lad
 
     lad = build()
-    assert lad.params["prox_form"] and not lad.params["adaptive_rho"]
+    sp = lad.solver_params()
+    assert lad.params["prox_form"] and not sp.adaptive_rho
+    assert sp.halpern and sp.rho0 == 60.0 and sp.max_iter == 40000
+    # The LP overlay must not leak into the shared params dict, and an
+    # epigraph fallback (external backend) must not see it.
+    assert "adaptive_rho" not in lad.params
+    epi = build(solver_name="ipm")
+    assert epi.solver_params().adaptive_rho  # SolverParams default
     assert lad.solve()
     w = np.asarray(lad.solution.x)[:N]
     obj = float(np.sum(np.abs(X @ w - y)))

@@ -225,6 +225,27 @@ class TestFactoredScaling:
         np.testing.assert_allclose(np.asarray(got.x), np.asarray(ref.x),
                                    atol=1e-7)
 
+    def test_factored_scaling_equilibrates_uniformly_tiny_problems(self, rng):
+        """Round-5 advisor fix: the live/padded cut is the exact-zero
+        test, not a magnitude floor — a uniformly tiny-scaled factor
+        (every P_jj far below any absolute threshold) must still
+        equilibrate to a unit-diagonal scaled P."""
+        from porqua_tpu.qp.canonical import CanonicalQP
+        from porqua_tpu.qp.ruiz import equilibrate_factored
+
+        n = 12
+        F = jnp.asarray(rng.standard_normal((20, n)) * 1e-8, jnp.float64)
+        P = 2.0 * F.T @ F
+        qp = CanonicalQP.build(np.asarray(P), np.zeros(n),
+                               C=np.ones((1, n)), l=np.ones(1),
+                               u=np.ones(1), lb=np.zeros(n),
+                               ub=np.ones(n), Pf=np.asarray(F),
+                               dtype=jnp.float64)
+        scaled, scaling = equilibrate_factored(qp)
+        diag = np.diag(np.asarray(scaled.P)) / float(scaling.c)
+        np.testing.assert_allclose(diag, 1.0, rtol=1e-6)
+        assert float(jnp.max(scaling.D)) > 1e3  # actually rescaled
+
     def test_factored_scaling_bench_shard_parity_f32(self, rng):
         """The exact bench headline config at a north-star shard on the
         suite's CPU backend: all solved, one clean segment, TE parity
