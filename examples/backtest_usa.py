@@ -214,14 +214,21 @@ def main():
     t_scan = time.perf_counter() - t0
 
     # The two engines must agree date by date (over the checked prefix).
+    # reindex: a missing asset surfaces as NaN and fails the finiteness
+    # check rather than vanishing into a max().
     max_dw = 0.0
     for date in rebdates[:n_check]:
         ws = pd.Series(bt_serial.strategy.get_weights(date))
         wb = pd.Series(bt_scan.strategy.get_weights(date))
-        max_dw = max(max_dw, float((wb[ws.index] - ws).abs().max()))
+        max_dw = max(max_dw,
+                     float((wb.reindex(ws.index) - ws).abs().max()))
     print(f"  serial {t_serial:.1f}s/{n_check} dates vs scan "
           f"{t_scan:.1f}s/{len(rebdates)} dates (incl. compile); "
           f"max |dw| serial-vs-scan {max_dw:.2e} over {n_check} dates")
+    # 5e-4 = the ridge-conditioning bound, see tests/test_backtest_usa.py
+    # — this example is part of the examples regression gate, so the
+    # parity claim must be an assertion, not a printout.
+    assert np.isfinite(max_dw) and max_dw < 5e-4, max_dw
 
     sim_to = simulate_strategy(bt_scan.strategy, X, fc=0.0, vc=0.001)
     perf_to = performance_summary(sim_to, benchmark=bm.iloc[:, 0])

@@ -168,6 +168,13 @@ class SolverParams:
     # kernel ignores the anchor, so admm_solve falls back to the XLA
     # segment and warns.
     halpern: bool = False
+    # Restart tuning: re-anchor when the scaled residual has decayed
+    # to halpern_decrease * (its value at the last restart), or
+    # forcibly after halpern_max_windows restart windows
+    # (check_interval iterations each) without one. Defaults from the
+    # production-scale sweep (scripts/lad_accel_sweep.py).
+    halpern_decrease: float = 0.25
+    halpern_max_windows: int = 8
     scaling_iters: int = 10
     # "ruiz": modified Ruiz sweeps over the dense P (scaling_iters of
     # them). "factored": Jacobi scaling computed from the objective
@@ -842,8 +849,9 @@ def admm_solve(qp: CanonicalQP,
                 r_prim / jnp.maximum(denom_p, 1e-12),
                 r_dual / jnp.maximum(denom_d, 1e-12))
             k_new = k_anchor + params.check_interval
-            restart = ((res_now <= 0.25 * res_anchor)
-                       | (k_new >= 8 * params.check_interval))
+            restart = ((res_now <= params.halpern_decrease * res_anchor)
+                       | (k_new >= params.halpern_max_windows
+                          * params.check_interval))
             cur = (x, z, w, y, mu)
             anchor = tuple(jnp.where(restart, c, a)
                            for c, a in zip(cur, anchor))

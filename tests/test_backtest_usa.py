@@ -175,7 +175,12 @@ def test_weights_and_net_returns_match_golden(usa_run):
     w_last = pd.Series(bt_scan.strategy.get_weights(rebdates[-1]))
     np.testing.assert_allclose(w_first.to_numpy(), g["w_first"], atol=2e-6)
     np.testing.assert_allclose(w_last.to_numpy(), g["w_last"], atol=2e-6)
-    np.testing.assert_allclose(sim.to_numpy(), g["net_returns"], atol=1e-9)
+    # Net returns are w . r, so the tolerance follows from the weight
+    # slack above: ||dw||_1 <= 489 * 2e-6 ~ 1e-3 against ~1%-scale
+    # daily returns bounds the drift by ~1e-5; 1e-6 holds with margin
+    # on same-platform reruns while staying consistent with what the
+    # weight checks permit.
+    np.testing.assert_allclose(sim.to_numpy(), g["net_returns"], atol=1e-6)
 
 
 def _regen():

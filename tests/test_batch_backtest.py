@@ -101,6 +101,33 @@ def test_batch_matches_serial_least_squares(market):
         np.testing.assert_allclose(wb[ws.index], ws, atol=5e-6)
 
 
+def test_batch_matches_serial_lad(market):
+    """LAD through run_batch: the batched engine must resolve the same
+    prox-form lowering AND the same solver-params overlay (round 5:
+    halpern + fixed LP step) as the serial engine — params are derived
+    via solver_params() after the problems are built, so both engines
+    run the identical algorithm; weights then agree to vmap-level
+    numerics."""
+    from porqua_tpu import LAD
+
+    rebdates = rebdates_of(market, k=4)
+
+    serial_bs = make_service(market, rebdates, LAD(dtype=jnp.float64))
+    serial = Backtest()
+    serial.run(serial_bs)
+
+    batch_bs = make_service(market, rebdates, LAD(dtype=jnp.float64))
+    assert batch_bs.optimization.solver_params().halpern  # overlay active
+    batched = run_batch(batch_bs, dtype=jnp.float64)
+
+    for date in rebdates:
+        ws = pd.Series(serial.strategy.get_weights(date))
+        wb = pd.Series(batched.strategy.get_weights(date))
+        assert abs(ws.sum() - 1.0) < 1e-6
+        np.testing.assert_allclose(wb[ws.index], ws, atol=5e-4,
+                                   err_msg=date)
+
+
 def test_batch_matches_serial_mean_variance(market):
     rebdates = rebdates_of(market, k=4)
 
