@@ -214,14 +214,15 @@ def main():
     t_scan = time.perf_counter() - t0
 
     # The two engines must agree date by date (over the checked prefix).
-    # reindex: a missing asset surfaces as NaN and fails the finiteness
-    # check rather than vanishing into a max().
+    # skipna=False + np.maximum: a missing asset's NaN propagates into
+    # max_dw and fails the finiteness assert (pandas' default max()
+    # would skip it, and builtin max() discards NaN).
     max_dw = 0.0
     for date in rebdates[:n_check]:
         ws = pd.Series(bt_serial.strategy.get_weights(date))
         wb = pd.Series(bt_scan.strategy.get_weights(date))
-        max_dw = max(max_dw,
-                     float((wb.reindex(ws.index) - ws).abs().max()))
+        d = (wb.reindex(ws.index) - ws).abs().max(skipna=False)
+        max_dw = float(np.maximum(max_dw, d))
     print(f"  serial {t_serial:.1f}s/{n_check} dates vs scan "
           f"{t_scan:.1f}s/{len(rebdates)} dates (incl. compile); "
           f"max |dw| serial-vs-scan {max_dw:.2e} over {n_check} dates")

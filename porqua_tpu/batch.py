@@ -417,6 +417,10 @@ def run_batch(bs: BacktestService,
     # lowering that is decided during canonical_parts.
     problems = build_problems(bs, dtype=dtype)
     if params is None:
-        params = bs.optimization.solver_params()
+        # Pass the BATCH dtype: the problems were just cast to it, and
+        # dtype-sensitive strategy defaults (LAD's f32 eps floor) must
+        # key on the dtype actually being solved, not the strategy's
+        # declaration.
+        params = bs.optimization.solver_params(solve_dtype=dtype)
     solution = solve_batch(problems, params)
     return assemble_backtest(problems, solution)

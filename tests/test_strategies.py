@@ -321,6 +321,16 @@ def test_lad_prox_form_matches_ipm_objective():
     sp = lad.solver_params()
     assert lad.params["prox_form"] and not sp.adaptive_rho
     assert sp.halpern and sp.rho0 == 60.0 and sp.max_iter == 40000
+    assert sp.eps_abs == 1e-5  # f64 build() keeps the tight target
+    # f32 (the device default) gets the floor-respecting 1e-4 overlay
+    # unless the caller says otherwise; an f64-declared strategy solved
+    # through an f32 batch (run_batch's default) must get it too.
+    assert LAD().solver_params().eps_abs == 1e-4
+    assert lad.solver_params(solve_dtype=jnp.float32).eps_abs == 1e-4
+    # An explicit eps on either key pins BOTH to the caller's intent —
+    # no half-relaxed configuration.
+    tight32 = LAD(eps_abs=1e-6).solver_params()
+    assert tight32.eps_abs == 1e-6 and tight32.eps_rel == 1e-5
     # The LP overlay must not leak into the shared params dict, and an
     # epigraph fallback (external backend) must not see it.
     assert "adaptive_rho" not in lad.params
