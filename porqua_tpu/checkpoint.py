@@ -150,8 +150,14 @@ def run_batch_checkpointed(bs,
     from porqua_tpu.batch import assemble_backtest, build_problems
     from porqua_tpu.qp.solve import solve_qp_batch
 
-    params = SolverParams() if params is None else params
+    # Same default as run_batch: the strategy's OWN lowering-aware
+    # solver configuration, keyed on the dtype actually being solved —
+    # a bare SolverParams() here would silently drop e.g. LAD's
+    # LP-prox overlay (fixed rho + halpern + f32 eps floor) and run
+    # the one configuration documented as never converging on the LP.
     problems = build_problems(bs, dtype=dtype)
+    if params is None:
+        params = bs.optimization.solver_params(solve_dtype=dtype)
     mgr = CheckpointManager.create(
         directory, problems.rebdates, chunk_size, params,
         dtype=dtype, has_l1=problems.l1_weight is not None,

@@ -310,3 +310,46 @@ def test_factored_pallas_segment_on_hardware(rng):
         np.asarray(pal.status))
     np.testing.assert_allclose(
         np.asarray(pal.x), np.asarray(ref.x), atol=5e-4)
+
+
+def test_lad_halpern_prox_on_hardware(rng):
+    """Round-5: the LAD prox lowering with its Halpern-anchored f32
+    overlay (fixed rho 60, alpha 1.8, eps 1e-4 — the dtype-aware
+    target that is actually reachable at the f32 residual floor),
+    solved on the chip through the strategy layer. The epigraph
+    lowering of the SAME problem is the objective cross-check."""
+    from porqua_tpu.constraints import Constraints
+    from porqua_tpu.optimization import LAD
+    from porqua_tpu.qp.ipm import solve_ipm
+    from porqua_tpu.tracking import synthetic_universe_np
+
+    N, T = 128, 96
+    Xs, ys = synthetic_universe_np(seed=17, n_dates=1, window=T,
+                                   n_assets=N)
+    X, y = Xs[0].astype(np.float64), ys[0].astype(np.float64)
+
+    def build(**kw):
+        lad = LAD(**kw)
+        cons = Constraints(selection=[f"a{i}" for i in range(N)])
+        cons.add_budget()
+        cons.add_box(lower=0.0, upper=1.0)
+        lad.constraints = cons
+        lad.objective = {"X": X, "y": y}
+        return lad
+
+    lad = build()
+    sp = lad.solver_params()
+    assert sp.halpern and sp.eps_abs == 1e-4  # the promoted f32 config
+    assert lad.solve()
+    w = np.asarray(lad.solution.x)[:N]
+    obj = float(np.sum(np.abs(X @ w - y)))
+    # Device iterations must reflect the Halpern cut, not a stall.
+    assert int(lad.solution.iters) < 20000, int(lad.solution.iters)
+
+    # f64 IPM oracle on host (the chip solves f32; the oracle is the
+    # accuracy yardstick, same pattern as the committed CPU evidence).
+    ipm = solve_ipm(build(prox_form=False).canonical_parts(), tol=1e-9)
+    obj_ipm = float(np.sum(np.abs(X @ np.asarray(ipm.x)[:N] - y)))
+    assert obj <= obj_ipm * (1 + 5e-3), (obj, obj_ipm)
+    np.testing.assert_allclose(np.sum(w), 1.0, atol=1e-4)
+    assert float(np.min(w)) > -1e-3
