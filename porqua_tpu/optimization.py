@@ -565,7 +565,7 @@ class LAD(Optimization):
     # canonical_parts.
     _LP_PROX_DEFAULTS = {"adaptive_rho": False, "rho0": 60.0,
                          "halpern": True, "alpha": 1.8,
-                         "check_interval": 200,
+                         "check_interval": 200, "rho_l1_scale": 10.0,
                          "max_iter": 40000, "eps_abs": 1e-5,
                          "eps_rel": 1e-5}
 

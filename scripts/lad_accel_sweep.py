@@ -67,26 +67,26 @@ def main():
     configs = [
         ("r4 baseline a1.6 ci25 rho30",
          {"halpern": False, "alpha": 1.6, "check_interval": 25,
-          "rho0": 30.0}),
+          "rho0": 30.0, "rho_l1_scale": 1.0}),
         ("r5 default (overlay)", {}),
         ("halpern a1.6 ci100 rho30",
          {"halpern": True, "alpha": 1.6, "check_interval": 100,
-          "rho0": 30.0}),
+          "rho0": 30.0, "rho_l1_scale": 1.0}),
         ("halpern a1.6 ci200 rho30",
          {"halpern": True, "alpha": 1.6, "check_interval": 200,
-          "rho0": 30.0}),
+          "rho0": 30.0, "rho_l1_scale": 1.0}),
         ("halpern a1.6 ci400 rho30",
          {"halpern": True, "alpha": 1.6, "check_interval": 400,
-          "rho0": 30.0}),
+          "rho0": 30.0, "rho_l1_scale": 1.0}),
         ("halpern a1.8 ci200 rho30",
          {"halpern": True, "alpha": 1.8, "check_interval": 200,
-          "rho0": 30.0}),
+          "rho0": 30.0, "rho_l1_scale": 1.0}),
         ("halpern a1.6 ci200 rho10",
          {"halpern": True, "alpha": 1.6, "check_interval": 200,
-          "rho0": 10.0}),
+          "rho0": 10.0, "rho_l1_scale": 1.0}),
         ("halpern a1.6 ci200 rho60",
          {"halpern": True, "alpha": 1.6, "check_interval": 200,
-          "rho0": 60.0}),
+          "rho0": 60.0, "rho_l1_scale": 1.0}),
     ]
     if os.environ.get("LAD_QUICK"):
         configs = configs[:3]

@@ -124,7 +124,7 @@ def main():
         ("prox halpern (LAD default)", {}),
         ("prox rho30 fixed (r4 config)",
          {"halpern": False, "alpha": 1.6, "check_interval": 25,
-          "rho0": 30.0}),
+          "rho0": 30.0, "rho_l1_scale": 1.0}),
     ]:
         lad = LAD(dtype=getattr(jnp, DTYPE), **extra)
         cons = Constraints(selection=[f"a{i}" for i in range(N)])

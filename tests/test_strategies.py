@@ -321,6 +321,7 @@ def test_lad_prox_form_matches_ipm_objective():
     sp = lad.solver_params()
     assert lad.params["prox_form"] and not sp.adaptive_rho
     assert sp.halpern and sp.rho0 == 60.0 and sp.max_iter == 40000
+    assert sp.rho_l1_scale == 10.0
     assert sp.eps_abs == 1e-5  # f64 build() keeps the tight target
     # f32 (the device default) gets the floor-respecting 1e-4 overlay
     # unless the caller says otherwise; an f64-declared strategy solved
