@@ -26,9 +26,17 @@ if _env_plat and "axon" not in _env_plat:
 
 import numpy as np
 
+# Self-enable x64 for the f64 default: without it jnp silently
+# truncates to f32 and every "f64" row actually measures the f32
+# residual floor (40k stalled iterations where the real f64 config
+# solves in ~3,400) — the chip job exports JAX_ENABLE_X64=1, but a
+# bare local run must not mislead.
+DTYPE = os.environ.get("LAD_DTYPE", "float64")
+if DTYPE == "float64":
+    jax.config.update("jax_enable_x64", True)
+
 N = int(os.environ.get("LAD_N", 500))
 T = int(os.environ.get("LAD_T", 252))
-DTYPE = os.environ.get("LAD_DTYPE", "float64")
 
 
 def build_lad_qp(rng, n, t, dtype):
@@ -93,6 +101,13 @@ def main():
         ("epigraph tight+polish", base),
         ("epigraph adaptive 50k", dataclasses.replace(base,
                                                       max_iter=50000)),
+        # Round 5: halpern + fixed rho RESCUES the epigraph (SOLVED vs
+        # the adaptive-rho stall) but lands 21-46x worse than the prox
+        # form on objective — measured so the comparison is on record.
+        ("epigraph halpern rho60", dataclasses.replace(
+            base, max_iter=40000, eps_abs=1e-5, eps_rel=1e-5,
+            adaptive_rho=False, rho0=60.0, halpern=True, alpha=1.8,
+            check_interval=200)),
     ]
     for label, params in configs:
         sol = solve_qp(qp, params)          # warm (compile)
