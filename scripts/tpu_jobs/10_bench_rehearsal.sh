@@ -4,8 +4,8 @@
 # Full driver-contract rehearsal: exactly what the driver runs at end of
 # round. Warms the persistent XLA compilation cache for the TPU child so
 # the driver's own run compiles from disk, and commits the evidence.
-python bench.py > BENCH_REHEARSAL_r04.json 2> .tpu_queue/bench_rehearsal.err
+python bench.py > BENCH_REHEARSAL_r05_tpu.json 2> .tpu_queue/bench_rehearsal.err
 rc=$?
-cat BENCH_REHEARSAL_r04.json
+cat BENCH_REHEARSAL_r05_tpu.json
 tail -20 .tpu_queue/bench_rehearsal.err
 exit $rc

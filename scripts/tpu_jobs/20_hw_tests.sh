@@ -1,8 +1,8 @@
 # TIMEOUT: 1500
 # ATTEMPTS: 4
 # SUCCESS: [1-9][0-9]* passed
-# Hardware test log (committed evidence): the 8 TPU tests incl. the
+# Hardware test log (committed evidence): the 11 TPU tests incl. the
 # woodbury-vs-trinv parity check — the promoted headline config has had
 # zero on-chip test coverage since the round-2 log.
-PORQUA_TPU_TESTS=1 python -m pytest tests -m tpu -v 2>&1 | tee TPU_TESTS_r04.txt
+PORQUA_TPU_TESTS=1 python -m pytest tests -m tpu -v 2>&1 | tee TPU_TESTS_r05.txt
 exit ${PIPESTATUS[0]}
