@@ -17,6 +17,55 @@ from porqua_tpu.qp.canonical import CanonicalQP, stack_qps
 from porqua_tpu.qp.solve import SolverParams, solve_qp_batch
 
 
+def make_market_service(optimization, *, with_bm=False, seed=7,
+                        n_assets=6, n_days=400, every=50, k_dates=5,
+                        box_upper=0.5):
+    """One copy of the BacktestService wiring for the checkpoint tests
+    — strategies and data extras vary per test, the builder plumbing
+    must not drift between them."""
+    import pandas as pd
+
+    from porqua_tpu.backtest import BacktestService
+    from porqua_tpu.builders import (
+        OptimizationItemBuilder,
+        SelectionItemBuilder,
+        bibfn_bm_series,
+        bibfn_box_constraints,
+        bibfn_budget_constraint,
+        bibfn_return_series,
+        bibfn_selection_data,
+    )
+
+    rng = np.random.default_rng(seed)
+    dates = pd.bdate_range("2020-01-01", periods=n_days)
+    X = pd.DataFrame(rng.standard_normal((n_days, n_assets)) * 0.01,
+                     index=dates,
+                     columns=[f"A{i}" for i in range(n_assets)])
+    data = {"return_series": X}
+    opt_builders = {
+        "returns": OptimizationItemBuilder(bibfn=bibfn_return_series,
+                                           width=100),
+        "budget": OptimizationItemBuilder(bibfn=bibfn_budget_constraint,
+                                          budget=1),
+        "box": OptimizationItemBuilder(bibfn=bibfn_box_constraints,
+                                       upper=box_upper),
+    }
+    if with_bm:
+        data["bm_series"] = pd.DataFrame(
+            {"bm": X.to_numpy() @ rng.dirichlet(np.ones(n_assets))},
+            index=dates)
+        opt_builders["bm"] = OptimizationItemBuilder(
+            bibfn=bibfn_bm_series, width=100, align=True)
+    rebdates = [str(d.date()) for d in dates[150::every][:k_dates]]
+    return BacktestService(
+        data=data,
+        selection_item_builders={
+            "data": SelectionItemBuilder(bibfn=bibfn_selection_data)},
+        optimization_item_builders=opt_builders,
+        optimization=optimization,
+        settings={"rebdates": rebdates, "quiet": True})
+
+
 def _random_batch(rng, n_problems=6, n=10, m=3):
     qps = []
     for _ in range(n_problems):
@@ -98,45 +147,9 @@ class TestCheckpointManager:
 
 class TestRunBatchCheckpointed:
     def _make_service(self):
-        import pandas as pd
-
-        from porqua_tpu.backtest import BacktestService
-        from porqua_tpu.builders import (
-            OptimizationItemBuilder,
-            SelectionItemBuilder,
-            bibfn_box_constraints,
-            bibfn_budget_constraint,
-            bibfn_return_series,
-            bibfn_selection_data,
-        )
         from porqua_tpu.optimization import QEQW
 
-        rng = np.random.default_rng(7)
-        n_assets, n_days = 6, 400
-        dates = pd.bdate_range("2020-01-01", periods=n_days)
-        X = pd.DataFrame(
-            rng.standard_normal((n_days, n_assets)) * 0.01,
-            index=dates,
-            columns=[f"A{i}" for i in range(n_assets)],
-        )
-        data = {"return_series": X}
-        rebdates = [str(d.date()) for d in dates[150::50][:5]]
-        return BacktestService(
-            data=data,
-            selection_item_builders={
-                "data": SelectionItemBuilder(bibfn=bibfn_selection_data),
-            },
-            optimization_item_builders={
-                "returns": OptimizationItemBuilder(
-                    bibfn=bibfn_return_series, width=100),
-                "budget": OptimizationItemBuilder(
-                    bibfn=bibfn_budget_constraint, budget=1),
-                "box": OptimizationItemBuilder(
-                    bibfn=bibfn_box_constraints, upper=0.5),
-            },
-            optimization=QEQW(),
-            settings={"rebdates": rebdates, "quiet": True},
-        )
+        return make_market_service(QEQW())
 
     def test_resume_matches_fresh(self, tmp_path):
         """A run interrupted after chunk 0 must finish to the same
@@ -168,3 +181,36 @@ class TestRunBatchCheckpointed:
         wf = fresh.strategy.get_weights_df()
         wr = resumed.strategy.get_weights_df()
         np.testing.assert_allclose(wf.values, wr.values, atol=1e-6)
+
+
+def test_checkpointed_default_params_match_run_batch(tmp_path):
+    """Round 5: with params=None, run_batch_checkpointed must derive
+    the SAME strategy-resolved solver configuration as run_batch — for
+    LAD that is the LP-prox overlay (halpern + fixed rho + dtype-aware
+    eps); a bare SolverParams() default here previously ran the
+    adaptive-rho config documented as never converging on the LP."""
+    import pandas as pd
+
+    from porqua_tpu.batch import run_batch
+    from porqua_tpu.optimization import LAD
+
+    def service():
+        return make_market_service(LAD(), with_bm=True, seed=9,
+                                   every=60, k_dates=4, box_upper=1.0)
+
+    rebdates = service().settings["rebdates"]
+    ck = run_batch_checkpointed(service(), str(tmp_path / "ck"),
+                                chunk_size=2)
+    ref = run_batch(service())
+    # Same derived config -> same convergence behavior (not the 40k
+    # adaptive-rho stall). Weights agree to f32-LP localization, not
+    # solver epsilon: the checkpointed path warm-starts each chunk
+    # from the previous chunk's endpoint while run_batch solves dates
+    # independently, and two eps=1e-4 f32 solves of a near-degenerate
+    # LP from different starts land ~1e-4 apart (measured 1.8e-4).
+    assert int(np.max(ck.output["batch"]["iters"])) < 20000
+    for date in rebdates:
+        wc = pd.Series(ck.strategy.get_weights(date))
+        wr = pd.Series(ref.strategy.get_weights(date))
+        np.testing.assert_allclose(wc[wr.index], wr, atol=1e-3,
+                                   err_msg=date)
