@@ -3,5 +3,5 @@
 # SUCCESS: RESULT lad prox halpern
 # LAD at the reference's production scale on chip (f64): the prox-form
 # production path vs the committed CPU numbers; IPM oracle runs on host.
-JAX_ENABLE_X64=1 python scripts/lad_scale_experiment.py 2>&1 | tee .tpu_queue/lad_scale.log
+JAX_ENABLE_X64=1 LAD_SKIP_NEGATIVE=1 python scripts/lad_scale_experiment.py 2>&1 | tee .tpu_queue/lad_scale.log
 exit ${PIPESTATUS[0]}
