@@ -5,5 +5,12 @@
 # with dense-P elision) at B=252 — the most decisive minutes of chip
 # time after the bench rehearsal; runs before the long hardware-test
 # suite so a short window still captures them.
-python scripts/measure_northstar.py 252 2>&1 | tee .tpu_queue/northstar_252.log
-exit ${PIPESTATUS[0]}
+mkdir -p chip_logs
+python scripts/measure_northstar.py 252 2>&1 | tee chip_logs/northstar_252_r05.part
+rc=${PIPESTATUS[0]}
+# Only a completed attempt publishes the tracked log — a
+# killed/failed attempt leaves only the ignored .part, so the
+# driver's auto-commit cannot capture truncated output as
+# round-5 evidence.
+[ $rc -eq 0 ] && mv chip_logs/northstar_252_r05.part chip_logs/northstar_252_r05.log
+exit $rc

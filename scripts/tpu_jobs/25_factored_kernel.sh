@@ -4,5 +4,12 @@
 # Round-4 factored Pallas segment vs XLA woodbury at the north-star
 # shape — decides whether the kernel joins the TPU headline config
 # (projected: sheds ~9 GB of per-iteration W re-reads).
-python scripts/measure_factored_kernel.py 252 500 2>&1 | tee .tpu_queue/factored_kernel.log
-exit ${PIPESTATUS[0]}
+mkdir -p chip_logs
+python scripts/measure_factored_kernel.py 252 500 2>&1 | tee chip_logs/factored_kernel_r05.part
+rc=${PIPESTATUS[0]}
+# Only a completed attempt publishes the tracked log — a
+# killed/failed attempt leaves only the ignored .part, so the
+# driver's auto-commit cannot capture truncated output as
+# round-5 evidence.
+[ $rc -eq 0 ] && mv chip_logs/factored_kernel_r05.part chip_logs/factored_kernel_r05.log
+exit $rc

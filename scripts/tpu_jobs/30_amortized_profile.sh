@@ -3,5 +3,12 @@
 # SUCCESS: step woodbury ruiz0
 # Stage profile + the Ruiz 0/1/2 sweep for the woodbury headline config
 # (roofline item: candidate 35 -> ~29 ms by shedding Ruiz re-reads).
-python scripts/profile_amortized.py 2>&1 | tee .tpu_queue/profile_amortized_r04.log
-exit ${PIPESTATUS[0]}
+mkdir -p chip_logs
+python scripts/profile_amortized.py 2>&1 | tee chip_logs/profile_amortized_r05.part
+rc=${PIPESTATUS[0]}
+# Only a completed attempt publishes the tracked log — a
+# killed/failed attempt leaves only the ignored .part, so the
+# driver's auto-commit cannot capture truncated output as
+# round-5 evidence.
+[ $rc -eq 0 ] && mv chip_logs/profile_amortized_r05.part chip_logs/profile_amortized_r05.log
+exit $rc

@@ -2,5 +2,12 @@
 # ATTEMPTS: 3
 # SUCCESS: RESULT northstar-woodbury B=1008
 # Batch-scaling evidence at B=1008 (trinv + woodbury headline config).
-python scripts/measure_northstar.py 1008 2>&1 | tee .tpu_queue/northstar_1008.log
-exit ${PIPESTATUS[0]}
+mkdir -p chip_logs
+python scripts/measure_northstar.py 1008 2>&1 | tee chip_logs/northstar_1008_r05.part
+rc=${PIPESTATUS[0]}
+# Only a completed attempt publishes the tracked log — a
+# killed/failed attempt leaves only the ignored .part, so the
+# driver's auto-commit cannot capture truncated output as
+# round-5 evidence.
+[ $rc -eq 0 ] && mv chip_logs/northstar_1008_r05.part chip_logs/northstar_1008_r05.log
+exit $rc

@@ -3,5 +3,12 @@
 # SUCCESS: RESULT lad prox halpern
 # LAD at the reference's production scale on chip (f64): the prox-form
 # production path vs the committed CPU numbers; IPM oracle runs on host.
-JAX_ENABLE_X64=1 LAD_SKIP_NEGATIVE=1 python scripts/lad_scale_experiment.py 2>&1 | tee .tpu_queue/lad_scale.log
-exit ${PIPESTATUS[0]}
+mkdir -p chip_logs
+JAX_ENABLE_X64=1 LAD_SKIP_NEGATIVE=1 python scripts/lad_scale_experiment.py 2>&1 | tee chip_logs/lad_scale_r05.part
+rc=${PIPESTATUS[0]}
+# Only a completed attempt publishes the tracked log — a
+# killed/failed attempt leaves only the ignored .part, so the
+# driver's auto-commit cannot capture truncated output as
+# round-5 evidence.
+[ $rc -eq 0 ] && mv chip_logs/lad_scale_r05.part chip_logs/lad_scale_r05.log
+exit $rc
