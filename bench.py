@@ -32,11 +32,15 @@ the tunnel black-holed — the 900 s child timeout x 2 attempts + an
   cache** (``.xla_cache/`` next to this file), so a TPU child landing
   late in the deadline — or the driver's run after a builder-session
   rehearsal — compiles from disk in seconds instead of ~60-90 s.
-* The CPU fallback runs at a **reduced, pre-validated size**
-  (PORQUA_BENCH_FALLBACK_DATES, default 32 — full-size XLA-CPU compile
-  alone takes minutes on this 1-core host) and is labeled as such in
-  the JSON; its speedup is computed per-date against the same-date-count
-  slice of the serial baseline. Round 4: the fallback child launches
+* The CPU fallback runs at **full size by default since round 5**
+  (PORQUA_BENCH_FALLBACK_DATES, default = the full date count; the
+  round-3 "compile takes minutes" premise died with the round-4
+  dense-P elision — B=252 compiles in ~8 s cold). A reduced run
+  (explicit env) is labeled as such in the JSON with a
+  linear-in-dates extrapolation field; its speedup compares per-date
+  against the serial baseline — the same-date-count slice when the
+  baseline sample covers the shard, else a labeled per-date
+  extrapolation of the measured baseline sample. Round 4: the fallback child launches
   **concurrently at the start** (probing is network-idle; the fallback
   is host-CPU work), so a dead tunnel no longer serializes
   probe-wait + fallback and the fallback result is banked early.
@@ -80,7 +84,15 @@ BASELINE_SAMPLE = int(os.environ.get("PORQUA_BENCH_BASELINE_DATES", 16))
 DEADLINE_S = int(os.environ.get("PORQUA_BENCH_DEADLINE", 570))
 PROBE_TIMEOUT = int(os.environ.get("PORQUA_BENCH_PROBE_TIMEOUT", 90))
 CHILD_TIMEOUT = int(os.environ.get("PORQUA_BENCH_CHILD_TIMEOUT", 300))
-FALLBACK_DATES = int(os.environ.get("PORQUA_BENCH_FALLBACK_DATES", 32))
+# Round 5: the fallback runs FULL SIZE by default. The round-3 "32
+# dates — full-size XLA-CPU compile alone takes minutes" premise is
+# stale: with the dense-P build elided from the program (round 4) the
+# B=252 compile+first measures 7.6 s cold on this host, and the full
+# solve is ~1.5 s warm — comfortably inside the child budget even
+# sharing the host with the probe loop. An explicit env still forces
+# a reduced shard (the contract test exercises that path).
+FALLBACK_DATES = int(os.environ.get("PORQUA_BENCH_FALLBACK_DATES",
+                                    N_DATES))
 
 _START = time.monotonic()
 _MARKER = "BENCHJSON:"
@@ -887,12 +899,14 @@ def run_device_benchmark(state):
             state["device"] = main_p
             state["secondary"] = [p for p in payloads
                                   if p.get("part", "").startswith("config")]
+            size = ("full size"
+                    if main_p.get("n_dates", 0) >= N_DATES
+                    else f"reduced size ({main_p.get('n_dates')} dates)")
             if forced == "cpu":
-                state["note"] = ("platform forced to cpu; measured at "
-                                 "reduced size")
+                state["note"] = f"platform forced to cpu; measured at {size}"
             else:
                 errors.insert(
-                    0, "tpu unavailable, measured on XLA-CPU at reduced size")
+                    0, f"tpu unavailable, measured on XLA-CPU at {size}")
     elif main_p is not None:
         # Both measured: keep the TPU headline, record the fallback's
         # wall-clock as a cross-platform cross-check.
