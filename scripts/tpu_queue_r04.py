@@ -78,8 +78,9 @@ def attempts_of(name):
 
 def bump_attempts(name):
     p = os.path.join(STATE, name + ".attempts")
+    n = attempts_of(name) + 1
     with open(p, "w") as f:
-        f.write(str(attempts_of(name) + 1))
+        f.write(str(n))
 
 
 def pending_jobs():
