@@ -42,7 +42,7 @@ import jax
 import jax.numpy as jnp
 from jax.scipy.linalg import cho_factor, cho_solve
 
-from porqua_tpu.qp.canonical import CanonicalQP
+from porqua_tpu.qp.canonical import HP as _HP, CanonicalQP
 from porqua_tpu.qp.ruiz import Scaling
 
 
@@ -249,8 +249,16 @@ def _rho_vectors(qp: CanonicalQP, rho_bar, params: SolverParams):
 
 
 def _residuals(qp: CanonicalQP, scaling: Scaling, x, z, w, y, mu, params: SolverParams):
-    """Unscaled residual norms and OSQP-style tolerance thresholds."""
-    Cx = qp.C @ x
+    """Unscaled residual norms and OSQP-style tolerance thresholds.
+
+    All matvecs here run at Precision.HIGHEST: the TPU MXU computes f32
+    ``@`` in bf16 passes by default, whose ~4e-3 relative error puts a
+    floor under the measurable dual residual — on chip the LAD prox
+    config stalled at r_dual ~1e-3 against its 1e-4 target purely from
+    the residual measurement (TPU_TESTS_r05). The solve is memory-bound
+    (MFU < 3%), so the extra passes are free.
+    """
+    Cx = jnp.dot(qp.C, x, precision=_HP)
     Einv = 1.0 / scaling.E
     Dinv = 1.0 / scaling.D
     cinv = 1.0 / scaling.c
@@ -262,7 +270,8 @@ def _residuals(qp: CanonicalQP, scaling: Scaling, x, z, w, y, mu, params: Solver
     # dense P unread on the factored pipeline so XLA can eliminate its
     # construction altogether.
     Px = qp.apply_P(x)
-    dual_vec = Px + qp.q + qp.C.T @ y + mu
+    CTy = jnp.dot(y, qp.C, precision=_HP)
+    dual_vec = Px + qp.q + CTy + mu
     r_dual = cinv * _inf_norm(Dinv * dual_vec)
 
     denom_p = jnp.max(jnp.array([
@@ -270,7 +279,7 @@ def _residuals(qp: CanonicalQP, scaling: Scaling, x, z, w, y, mu, params: Solver
         _inf_norm(scaling.D * x), _inf_norm(scaling.D * w),
     ]))
     denom_d = cinv * jnp.max(jnp.array([
-        _inf_norm(Dinv * Px), _inf_norm(Dinv * (qp.C.T @ y)),
+        _inf_norm(Dinv * Px), _inf_norm(Dinv * CTy),
         _inf_norm(Dinv * qp.q), _inf_norm(Dinv * mu),
     ]))
 
@@ -301,7 +310,7 @@ def _infeasibility(qp: CanonicalQP, scaling: Scaling, dx, dy, dmu,
     lb_un = qp.lb * scaling.D
     ub_un = qp.ub * scaling.D
     # C_un' dy_u = D^-1 C_hat' E^-1 dy_u = (1/c) D^-1 (C_hat' dyhat)
-    CTdy = (1.0 / scaling.D) * (qp.C.T @ dy) * (1.0 / scaling.c)
+    CTdy = (1.0 / scaling.D) * jnp.dot(dy, qp.C, precision=_HP) * (1.0 / scaling.c)
     pinf_resid = _inf_norm(CTdy + dmu_u)
     support = (
         _support(u_un, l_un, dy_u) + _support(ub_un, lb_un, dmu_u)
@@ -315,11 +324,11 @@ def _infeasibility(qp: CanonicalQP, scaling: Scaling, dx, dy, dmu,
     # Dual infeasibility: P dx ~ 0, q'dx < 0, C dx in recession cone
     norm_dx = _inf_norm(dx_u)
     Pdx = (1.0 / scaling.c) * (1.0 / scaling.D) * qp.apply_P(dx)
-    qdx = (1.0 / scaling.c) * jnp.dot(qp.q, dx)
+    qdx = (1.0 / scaling.c) * jnp.dot(qp.q, dx, precision=_HP)
     if l1w is not None:
         # Unscaled L1 slope: sum_i w_i |D_i dx_i| = (1/c) sum_i l1w_i |dx_i|.
         qdx = qdx + (1.0 / scaling.c) * jnp.sum(l1w * jnp.abs(dx))
-    Cdx = (1.0 / scaling.E) * (qp.C @ dx)
+    Cdx = (1.0 / scaling.E) * jnp.dot(qp.C, dx, precision=_HP)
     tol = params.eps_dinf * norm_dx
     cone_ok = jnp.all(
         jnp.where(jnp.isfinite(u_un), Cdx <= tol, True)
@@ -385,7 +394,7 @@ def factored_solve_pieces(Dv: jax.Array, V: jax.Array):
     exactly these two arrays VMEM-resident across a whole segment."""
     dtype = V.dtype
     k = V.shape[-2]
-    hp = jax.lax.Precision.HIGHEST
+    hp = _HP
     inv_d = 1.0 / Dv
     Vd = V * inv_d[None, :]
     S = jnp.eye(k, dtype=dtype) + jnp.dot(Vd, V.T, precision=hp)
@@ -426,7 +435,7 @@ def factored_solve_from_pieces(Dv, V, inv_d, W, refine_steps: int = 1):
     callers that also need ``(inv_d, W)`` directly (the fused Pallas
     factored segment) build them once and share, instead of paying the
     k x k factorization twice per segment."""
-    hp = jax.lax.Precision.HIGHEST
+    hp = _HP
 
     def base(rhs):
         t = jnp.dot(W, rhs, precision=hp)
@@ -475,7 +484,7 @@ def blocked_triangular_inverse(L: jax.Array,
 
     n1 = (n + 1) // 2     # >= n - n1, so both blocks fit in (n1, n1)
     n2 = n - n1
-    hp = jax.lax.Precision.HIGHEST
+    hp = _HP
     L11 = L[..., :n1, :n1]
     L21 = L[..., n1:, :n1]
     L22 = L[..., n1:, n1:]
@@ -532,7 +541,7 @@ def admm_solve(qp: CanonicalQP,
 
     x_init = jnp.zeros(n, dtype) if x0 is None else x0
     y_init = jnp.zeros(m, dtype) if y0 is None else y0
-    z_init = qp.C @ x_init
+    z_init = jnp.dot(qp.C, x_init, precision=_HP)
     w_init = jnp.clip(x_init, qp.lb, qp.ub)
 
     init = ADMMState(
@@ -546,9 +555,10 @@ def admm_solve(qp: CanonicalQP,
 
     def one_iteration(carry, solve, rho, rho_b):
         x, z, w, y, mu = carry
-        rhs = sigma * x - qp.q + qp.C.T @ (rho * z - y) + (rho_b * w - mu)
+        rhs = (sigma * x - qp.q + jnp.dot(rho * z - y, qp.C, precision=_HP)
+               + (rho_b * w - mu))
         xt = solve(rhs)
-        zt = qp.C @ xt
+        zt = jnp.dot(qp.C, xt, precision=_HP)
 
         x_new = alpha * xt + (1 - alpha) * x
 
@@ -658,7 +668,7 @@ def admm_solve(qp: CanonicalQP,
         segment."""
         eye = jnp.eye(n, dtype=dtype)
         Kinv = cho_solve(chol, eye)
-        hp = jax.lax.Precision.HIGHEST
+        hp = _HP
         return jnp.dot(
             Kinv, 2.0 * eye - jnp.dot(K, Kinv, precision=hp), precision=hp
         )
@@ -705,7 +715,7 @@ def admm_solve(qp: CanonicalQP,
             inv_d_w, W_w = factored_solve_pieces(Dv, V)
             psolve0 = factored_solve_from_pieces(
                 Dv, V, inv_d_w, W_w, refine_steps=params.woodbury_refine)
-            hp = jax.lax.Precision.HIGHEST
+            hp = _HP
             Y0 = jax.vmap(psolve0, in_axes=1, out_axes=1)(qp.C.T)  # (n, m)
             G = jnp.diag(1.0 / rho) + jnp.dot(qp.C, Y0, precision=hp)
 
@@ -719,7 +729,7 @@ def admm_solve(qp: CanonicalQP,
             K = (
                 qp.P
                 + sigma * jnp.eye(n, dtype=dtype)
-                + (qp.C.T * rho) @ qp.C
+                + jnp.dot(qp.C.T * rho, qp.C, precision=_HP)
                 + jnp.diag(rho_b)
             )
 
@@ -771,7 +781,7 @@ def admm_solve(qp: CanonicalQP,
                     triangular=triangular,
                 )
         else:
-            hp = jax.lax.Precision.HIGHEST
+            hp = _HP
             if linsolve == "woodbury":
                 pass  # `solve` built above with the eq-row Schur split
             elif linsolve == "trinv":

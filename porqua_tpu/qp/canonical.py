@@ -39,6 +39,13 @@ from typing import NamedTuple, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+
+# The one precision policy for every residual-bearing matvec/Gram in the
+# QP stack (admm, polish, canonical): HIGHEST, because the TPU MXU
+# computes f32 ``@`` in bf16 passes by default (~4e-3 relative error),
+# which perturbs iterates and floors measurable residuals; the ADMM
+# stages are memory-bound, so the extra passes cost nothing measurable.
+HP = jax.lax.Precision.HIGHEST
 import numpy as np
 
 
@@ -95,9 +102,9 @@ class CanonicalQP(NamedTuple):
         form agrees with the dense product to rounding by the
         ``P == 2 Pf'Pf + diag(Pdiag)`` build invariant.
         """
+        hp = HP
         if self.Pf is None:
-            return jnp.einsum("...ij,...j->...i", self.P, v)
-        hp = jax.lax.Precision.HIGHEST
+            return jnp.einsum("...ij,...j->...i", self.P, v, precision=hp)
         t = jnp.einsum("...rj,...j->...r", self.Pf, v, precision=hp)
         out = 2.0 * jnp.einsum("...rj,...r->...j", self.Pf, t, precision=hp)
         if self.Pdiag is not None:

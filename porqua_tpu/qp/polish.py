@@ -32,7 +32,7 @@ from porqua_tpu.qp.admm import (
     _residuals,
     factored_spd_solve_operator,
 )
-from porqua_tpu.qp.canonical import CanonicalQP
+from porqua_tpu.qp.canonical import HP as _HP, CanonicalQP
 from porqua_tpu.qp.ruiz import Scaling
 
 
@@ -70,7 +70,7 @@ def _kkt_solve_factored(qp: CanonicalQP, params: SolverParams,
     dtype = qp.P.dtype
     n, m = qp.n, qp.m
     sigma = delta  # same clamped regularizer the dense path uses
-    hp = jax.lax.Precision.HIGHEST
+    hp = _HP
 
     pd = jnp.zeros(n, dtype) if qp.Pdiag is None else qp.Pdiag
     Z = 1.0 - aB
@@ -221,24 +221,26 @@ def _kkt_solve_dense(qp: CanonicalQP, params: SolverParams,
     reaches both.
     """
     dtype = qp.P.dtype
+    hp = _HP
     inv_d = 1.0 / delta
     bC = aC * bound_C
     bB = aB * bound_B
     M = (
         qp.P + delta * jnp.eye(qp.n, dtype=dtype)
-        + inv_d * ((qp.C.T * aC) @ qp.C + jnp.diag(aB))
+        + inv_d * (jnp.dot(qp.C.T * aC, qp.C, precision=hp) + jnp.diag(aB))
     )
     cholM = cho_factor(M)
     msolve = lambda v: cho_solve(cholM, v)
-    x_i = msolve(-q_eff + inv_d * (qp.C.T @ bC + bB))
-    nu = aC * (qp.C @ x_i - bound_C) * inv_d
+    x_i = msolve(-q_eff + inv_d * (jnp.dot(bC, qp.C, precision=hp) + bB))
+    nu = aC * (jnp.dot(qp.C, x_i, precision=hp) - bound_C) * inv_d
     tau = aB * (x_i - bound_B) * inv_d
     for _ in range(params.polish_refine_steps):
-        r1 = -q_eff - (qp.P @ x_i + qp.C.T @ nu + tau)
-        r2 = aC * (bound_C - qp.C @ x_i)
+        r1 = -q_eff - (jnp.dot(qp.P, x_i, precision=hp)
+                       + jnp.dot(nu, qp.C, precision=hp) + tau)
+        r2 = aC * (bound_C - jnp.dot(qp.C, x_i, precision=hp))
         r3 = aB * (bound_B - x_i)
-        dx = msolve(r1 + inv_d * (qp.C.T @ r2 + r3))
-        nu = nu + aC * (qp.C @ dx - r2) * inv_d
+        dx = msolve(r1 + inv_d * (jnp.dot(r2, qp.C, precision=hp) + r3))
+        nu = nu + aC * (jnp.dot(qp.C, dx, precision=hp) - r2) * inv_d
         tau = tau + aB * (dx - r3) * inv_d
         x_i = x_i + dx
     return x_i, nu, tau
@@ -336,7 +338,8 @@ def _polish_pass(qp: CanonicalQP,
     # below), while an eps_abs-sized threshold classifies everything
     # whose dual is merely small as inactive/active wholesale.
     prox_err = jnp.maximum(
-        jnp.max(jnp.abs(qp.C @ x - z)) if m else jnp.asarray(0.0, dtype),
+        jnp.max(jnp.abs(jnp.dot(qp.C, x, precision=_HP) - z))
+        if m else jnp.asarray(0.0, dtype),
         jnp.max(jnp.abs(x - w)),
     )
     tiny = 1e3 * jnp.asarray(jnp.finfo(dtype).eps, dtype)
@@ -433,7 +436,7 @@ def _polish_pass(qp: CanonicalQP,
     # stationarity vector P x + q + C'y + mu is evaluated against the
     # ORIGINAL q, matching how the ADMM iterate carries the L1 term.
     mu_p = tau_p + (l1_weight * sub_sign if has_l1 else 0.0)
-    z_p = jnp.clip(qp.C @ x_p, qp.l, qp.u)
+    z_p = jnp.clip(jnp.dot(qp.C, x_p, precision=_HP), qp.l, qp.u)
     w_p = jnp.clip(x_p, qp.lb, qp.ub)
 
     rp1, rd1, *_ = _residuals(qp, scaling, x_p, z_p, w_p, y_p, mu_p, params)
