@@ -1,0 +1,28 @@
+#!/bin/bash
+# Launch (or relaunch) the chip-queue runner fully detached. Kills any
+# previous instance by pidfile — not pkill pattern-matching, which has
+# twice taken down the launching shell itself (its own command line
+# contains the pattern).
+cd "$(dirname "$0")/.."
+PIDFILE=.tpu_queue/runner.pid
+JOBPID=.tpu_queue/current_job.pid
+if [[ -f $PIDFILE ]] && kill -0 "$(cat $PIDFILE)" 2>/dev/null; then
+  kill -9 "$(cat $PIDFILE)" 2>/dev/null
+  sleep 1
+fi
+# A wedged in-flight job survives the runner (own process group, by
+# design) and would hold the TPU runtime across the restart.
+if [[ -f $JOBPID ]]; then
+  kill -9 -- "-$(cat $JOBPID)" 2>/dev/null
+  rm -f $JOBPID
+fi
+mkdir -p .tpu_queue
+setsid nohup python scripts/tpu_queue_r04.py >> .tpu_queue/runner_r05.log 2>&1 < /dev/null &
+echo $! > $PIDFILE
+sleep 2
+if kill -0 "$(cat $PIDFILE)" 2>/dev/null; then
+  echo "runner up: pid $(cat $PIDFILE)"
+else
+  echo "runner FAILED to start; see .tpu_queue/runner_r05.log"
+  exit 1
+fi

@@ -4,8 +4,11 @@
 # Full driver-contract rehearsal: exactly what the driver runs at end of
 # round. Warms the persistent XLA compilation cache for the TPU child so
 # the driver's own run compiles from disk, and commits the evidence.
-python bench.py > BENCH_REHEARSAL_r05_tpu.json 2> .tpu_queue/bench_rehearsal.err
+# stderr tees through to the runner so its stall watchdog sees the
+# bench's progress lines (stdout must stay clean JSON).
+python bench.py > BENCH_REHEARSAL_r05_tpu.json 2> >(tee .tpu_queue/bench_rehearsal.err >&2)
 rc=$?
+wait  # for the async tee: its writes race the tail below and bash's exit
 cat BENCH_REHEARSAL_r05_tpu.json
 tail -20 .tpu_queue/bench_rehearsal.err
 exit $rc

@@ -1,6 +1,7 @@
 # TIMEOUT: 900
 # ATTEMPTS: 3
 # SUCCESS: RESULT pallas-xover n=2000 B=8 pallas-inverse
+# STALL: 600
 # Kernel crossover at n=2000 (round-3 attempts OOMed; a structural VMEM
 # failure printed as RESULT ... FAILED still counts as measured).
 mkdir -p chip_logs

@@ -1,6 +1,7 @@
 # TIMEOUT: 1500
 # ATTEMPTS: 2
 # SUCCESS: RESULT lad prox halpern
+# STALL: 900
 # LAD at the reference's production scale on chip (f64): the prox-form
 # production path vs the committed CPU numbers; IPM oracle runs on host.
 mkdir -p chip_logs

@@ -15,6 +15,9 @@ directives, parsed from leading comment lines:
     # TIMEOUT: 900        child wall-clock cap (seconds)
     # ATTEMPTS: 3         max attempts before the job is parked
     # SUCCESS: regex      job is done iff rc==0 AND regex in output
+    # STALL: 300          kill early if the job's merged output goes
+    #                     quiet this long (default TPU_JOB_STALL_S=300;
+    #                     raise for jobs with long silent phases)
 
 State/markers/logs in ``.tpu_queue/`` (gitignored). Every job runs
 with a persistent XLA compilation cache (JAX_COMPILATION_CACHE_DIR)
@@ -34,6 +37,7 @@ STATE = os.path.join(ROOT, ".tpu_queue")
 DEADLINE_H = float(os.environ.get("TPU_QUEUE_HOURS", 11.5))
 PROBE_TIMEOUT = int(os.environ.get("TPU_PROBE_TIMEOUT", 90))
 SLEEP_S = int(os.environ.get("TPU_RETRY_SLEEP", 110))
+STALL_S = int(os.environ.get("TPU_JOB_STALL_S", 300))
 
 PROBE = r'''
 import jax, numpy as np, jax.numpy as jnp
@@ -59,13 +63,22 @@ def probe() -> bool:
 
 
 def parse_header(path):
-    cfg = {"TIMEOUT": 900, "ATTEMPTS": 3, "SUCCESS": None}
+    cfg = {"TIMEOUT": 900, "ATTEMPTS": 3, "SUCCESS": None, "STALL": STALL_S}
     with open(path) as f:
         for line in f:
-            m = re.match(r"#\s*(TIMEOUT|ATTEMPTS|SUCCESS):\s*(.+)", line)
+            m = re.match(r"#\s*(TIMEOUT|ATTEMPTS|SUCCESS|STALL):\s*(.+)", line)
             if m:
                 k, v = m.group(1), m.group(2).strip()
-                cfg[k] = int(v) if k in ("TIMEOUT", "ATTEMPTS") else v
+                if k in ("TIMEOUT", "ATTEMPTS", "STALL"):
+                    try:
+                        cfg[k] = int(v)
+                    except ValueError:
+                        # Jobs are edited live; a typo must not crash
+                        # the detached runner out of its rare window.
+                        log(f"{os.path.basename(path)}: bad {k}={v!r}; "
+                            f"using default {cfg[k]}")
+                else:
+                    cfg[k] = v
             elif line.strip() and not line.startswith("#"):
                 break
     return cfg
@@ -107,6 +120,10 @@ def run_job(name, path, cfg):
     env.setdefault("JAX_COMPILATION_CACHE_DIR",
                    os.path.join(ROOT, ".xla_cache"))
     env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+    # The stall watchdog below reads the job's merged output; python's
+    # default block-buffering on a pipe could hold a healthy job's few
+    # hundred bytes of progress lines past STALL_S.
+    env["PYTHONUNBUFFERED"] = "1"
     log(f"job {name} attempt {attempts_of(name)}/{cfg['ATTEMPTS']} "
         f"(timeout {cfg['TIMEOUT']}s)")
     t0 = time.monotonic()
@@ -115,19 +132,67 @@ def run_job(name, path, cfg):
     # grandchild (the exact black-holed-tunnel case this runner exists
     # for) alive and holding the TPU runtime, poisoning every later
     # attempt in the session.
+    # Binary pipe: the stall watchdog polls with non-blocking reads,
+    # and a text-mode stream's decoder chokes on the None an empty
+    # non-blocking read returns.
     proc = subprocess.Popen(["bash", path], stdout=subprocess.PIPE,
-                            stderr=subprocess.STDOUT, text=True, env=env,
+                            stderr=subprocess.STDOUT, env=env,
                             cwd=ROOT, start_new_session=True)
-    try:
-        out, _ = proc.communicate(timeout=cfg["TIMEOUT"])
-        rc = proc.returncode
-    except subprocess.TimeoutExpired:
+    # The relauncher (scripts/start_queue.sh) kills this group too: the
+    # runner pid alone leaving a wedged job's tree alive would hold the
+    # TPU runtime across the restart.
+    jobpid_path = os.path.join(STATE, "current_job.pid")
+    with open(jobpid_path, "w") as f:
+        f.write(str(proc.pid))
+    # Stall watchdog on top of the hard timeout: a tunnel that dies
+    # mid-job black-holes device ops, so the job produces no output and
+    # would otherwise sit until the full TIMEOUT (round-5 window: a
+    # wedged hw-test attempt held the queue 25 of the window's ~35
+    # minutes). No output for STALL_S -> kill and let the probe gate
+    # decide when to retry. STALL_S must exceed the longest silent
+    # compile; on-chip compiles here are ~70s cold, seconds cached.
+    os.set_blocking(proc.stdout.fileno(), False)
+    deadline = time.monotonic() + cfg["TIMEOUT"]
+    last_out = time.monotonic()
+    chunks = []
+    rc = None
+    while True:
+        chunk = proc.stdout.read()
+        if chunk:
+            chunks.append(chunk)
+            last_out = time.monotonic()
+        rc = proc.poll()
+        if rc is not None:
+            break
+        now = time.monotonic()
+        stall_s = cfg["STALL"]
+        if now > deadline or now - last_out > stall_s:
+            why = "timeout" if now > deadline else f"stalled {stall_s}s"
+            log(f"job {name}: killing ({why})")
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            proc.wait()
+            rc = -9
+            break
+        time.sleep(2)
+    # Drain to EOF (not just first EAGAIN) in both exit paths: writers
+    # are dead, and the tail holds the SUCCESS line on the happy path
+    # or the last pre-hang diagnostics on a kill.
+    while True:
         try:
-            os.killpg(proc.pid, signal.SIGKILL)
-        except ProcessLookupError:
-            pass
-        out, _ = proc.communicate()
-        out, rc = out or "", -9
+            chunk = proc.stdout.read()
+        except ValueError:
+            break
+        if not chunk:
+            break
+        chunks.append(chunk)
+    try:
+        os.remove(jobpid_path)
+    except OSError:
+        pass
+    out = b"".join(chunks).decode(errors="replace")
     with open(logp, "a") as f:
         f.write(f"\n===== attempt {attempts_of(name)} rc={rc} "
                 f"{time.strftime('%H:%M:%S')} "
