@@ -20,7 +20,7 @@ from typing import NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
-from porqua_tpu.qp.canonical import CanonicalQP
+from porqua_tpu.qp.canonical import CanonicalQP, HP
 from porqua_tpu.qp.solve import QPSolution, SolverParams, _solve_impl
 
 
@@ -38,8 +38,14 @@ def build_tracking_qp(X: jax.Array,
     """
     dtype = X.dtype
     n = X.shape[-1]
-    P = 2.0 * (X.T @ X) + (2.0 * ridge) * jnp.eye(n, dtype=dtype)
-    q = -2.0 * (X.T @ y)
+    # HIGHEST precision (shared policy, see qp/canonical.HP): on TPU the
+    # default bf16 passes would perturb the assembled problem ~4e-3
+    # relative. P is dead code on the factored pipeline (apply_P elides
+    # it), so the Gram's extra passes cost nothing there.
+    hp = HP
+    P = 2.0 * jnp.dot(X.T, X, precision=hp) \
+        + (2.0 * ridge) * jnp.eye(n, dtype=dtype)
+    q = -2.0 * jnp.dot(y, X, precision=hp)
     one = jnp.ones((1,), dtype)
     return CanonicalQP(
         P=P,
@@ -84,7 +90,7 @@ def tracking_step(Xs: jax.Array,
     def one(X, y):
         qp = build_tracking_qp(X, y, ridge=ridge)
         sol = _solve_impl(qp, params, None, None)
-        resid = X @ sol.x - y
+        resid = jnp.dot(X, sol.x, precision=HP) - y
         te = jnp.sqrt(jnp.mean(resid * resid))
         return sol, te
 

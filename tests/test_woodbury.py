@@ -300,3 +300,30 @@ class TestFactoredScaling:
         bad = [ln for ln in hlo.splitlines()
                if "dot(" in ln and f"{n},{n}" in ln.replace(" ", "")]
         assert not bad, bad[:3]
+
+    def test_headline_program_has_no_default_precision_dots(self):
+        """Regression pin for the round-5 bf16-floor fix: every
+        dot_general in the lowered headline program must carry
+        Precision.HIGHEST. On TPU the DEFAULT precision computes f32
+        matmuls in bf16 passes (~4e-3 relative), which floored the
+        measurable dual residual at ~1e-3 on hardware
+        (TPU_TESTS_r05.txt, test_lad_halpern_prox_on_hardware) — a
+        single new default-precision matvec anywhere in the solve
+        pipeline would silently reintroduce it."""
+        import re
+
+        from porqua_tpu.tracking import synthetic_universe_np, tracking_step
+
+        Xs_np, ys_np = synthetic_universe_np(seed=1, n_dates=2,
+                                             window=96, n_assets=160)
+        Xs, ys = jnp.asarray(Xs_np), jnp.asarray(ys_np)
+        fac = SolverParams(max_iter=2000, eps_abs=1e-3, eps_rel=1e-3,
+                           polish=False, linsolve="woodbury",
+                           woodbury_refine=0, check_interval=35,
+                           scaling_mode="factored")
+        low = (jax.jit(lambda X: tracking_step(X, ys, fac))
+               .lower(Xs).as_text())
+        dots = re.findall(r"stablehlo\.dot_general.*", low)
+        assert dots, "lowering produced no dot_general ops?"
+        bad = [d[:140] for d in dots if "HIGHEST" not in d]
+        assert not bad, bad[:3]
