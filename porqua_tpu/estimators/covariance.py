@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from porqua_tpu.utils.psd import is_psd, project_psd
+from porqua_tpu.qp.canonical import HP as _HP
 
 
 def cov_pearson(X: jax.Array) -> jax.Array:
@@ -34,7 +35,10 @@ def cov_pearson(X: jax.Array) -> jax.Array:
     T = X.shape[-2]
     mean = jnp.mean(X, axis=-2, keepdims=True)
     Xc = X - mean
-    return jnp.einsum("...ti,...tj->...ij", Xc, Xc) / (T - 1)
+    # HIGHEST precision (shared policy, qp/canonical.HP): this Gram
+    # becomes the QP's P; the TPU default bf16 passes would perturb it
+    # ~4e-3 relative before the solver ever sees the problem.
+    return jnp.einsum("...ti,...tj->...ij", Xc, Xc, precision=_HP) / (T - 1)
 
 
 def cov_duv(X: jax.Array) -> jax.Array:
@@ -79,8 +83,8 @@ def ledoit_wolf_params(X: jax.Array):
     eye = jnp.eye(n, dtype=X.dtype)
     d2 = jnp.sum((S - mu * eye) ** 2, axis=(-2, -1))
     # b2 = (1/T^2) sum_t || x_t x_t' - S ||_F^2
-    xxT_norms = jnp.einsum("...ti,...tj->...t", Xc, Xc) ** 2  # ||x_t||^4
-    cross = jnp.einsum("...ti,...ij,...tj->...t", Xc, S, Xc)
+    xxT_norms = jnp.einsum("...ti,...tj->...t", Xc, Xc, precision=_HP) ** 2  # ||x_t||^4
+    cross = jnp.einsum("...ti,...ij,...tj->...t", Xc, S, Xc, precision=_HP)
     b2_raw = (jnp.sum(xxT_norms, axis=-1) - 2 * jnp.sum(cross, axis=-1)
               + T * jnp.sum(S * S, axis=(-2, -1))) / T**2
     b2 = jnp.minimum(b2_raw, d2)

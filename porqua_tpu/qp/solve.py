@@ -25,7 +25,7 @@ from porqua_tpu.qp.admm import (
     _residuals,
     _support,
 )
-from porqua_tpu.qp.canonical import CanonicalQP
+from porqua_tpu.qp.canonical import CanonicalQP, HP
 from porqua_tpu.qp.polish import polish_iterate as _polish_iterate
 from porqua_tpu.qp.ruiz import Scaling, equilibrate, equilibrate_factored
 
@@ -117,7 +117,8 @@ def _solve_impl(qp: CanonicalQP,
     # computed against the original (unscaled) bounds.
     if l1_weight is None:
         gap = jnp.abs(
-            jnp.dot(x_u, qp.apply_P(x_u)) + jnp.dot(qp.q, x_u)
+            jnp.dot(x_u, qp.apply_P(x_u), precision=HP)
+            + jnp.dot(qp.q, x_u, precision=HP)
             + _support(qp.u, qp.l, y_u) + _support(qp.ub, qp.lb, mu_u)
         )
     else:
@@ -137,8 +138,10 @@ def _solve_impl(qp: CanonicalQP,
         g = jnp.clip(mu_u, -l1_weight, l1_weight)
         mu_box = mu_u - g
         gap = jnp.abs(
-            jnp.dot(x_u, qp.apply_P(x_u)) + jnp.dot(qp.q, x_u)
-            + jnp.sum(l1_weight * jnp.abs(dx_c)) + jnp.dot(c_vec, g)
+            jnp.dot(x_u, qp.apply_P(x_u), precision=HP)
+            + jnp.dot(qp.q, x_u, precision=HP)
+            + jnp.sum(l1_weight * jnp.abs(dx_c))
+            + jnp.dot(c_vec, g, precision=HP)
             + _support(qp.u, qp.l, y_u) + _support(qp.ub, qp.lb, mu_box)
         )
 
