@@ -56,6 +56,13 @@ batched program. Both are measured at reduced date counts and labeled.
 Round 4: the CPU fallback emits them too (smaller still — 8 chained
 dates / a 6x21 grid), so the official artifact carries config-4/5
 numbers even when the tunnel is down all round (round-3 verdict item).
+Round 6 adds a ``serving`` config (``config_serving``): the online
+solve service (:mod:`porqua_tpu.serve` — shape-bucketed dynamic
+batching over an AOT compiled-executable cache) driven closed-loop by
+``scripts/serve_loadgen.py``'s engine on the config-5 grid shape,
+reporting sustained throughput, p50/p99 latency, mean batch occupancy,
+and the recompile-after-warmup count (contract: 0). Emitted by both
+the TPU child and the CPU fallback.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} (plus
 diagnostic fields) where value = device wall-clock seconds for the full
@@ -523,6 +530,15 @@ def device_child(platform: str, n_dates: int) -> None:
                                    n_dates=21, n_assets=24)
             else:
                 log(f"skipping cpu config 5 ({child_left():.0f}s left)")
+            if child_left() > 60:
+                # Reduced for the fallback child's tighter budget: a
+                # 7-executable prewarm ladder instead of 8, half the
+                # stream.
+                _secondary_config_serving(child_left, n_requests=512,
+                                          max_batch=64)
+            else:
+                log(f"skipping cpu serving config "
+                    f"({child_left():.0f}s left)")
         except Exception as e:  # pragma: no cover - best-effort extras
             log(f"cpu secondary metrics aborted: {type(e).__name__}: {e}")
         return
@@ -549,6 +565,10 @@ def device_child(platform: str, n_dates: int) -> None:
             _secondary_config2(params_sec, child_left, Xs, n_dates)
         else:
             log(f"skipping config 2 ({child_left():.0f}s left)")
+        if child_left() > 90:
+            _secondary_config_serving(child_left)
+        else:
+            log(f"skipping serving config ({child_left():.0f}s left)")
     except Exception as e:  # pragma: no cover - best-effort extras
         log(f"secondary metrics aborted: {type(e).__name__}: {e}")
 
@@ -661,6 +681,60 @@ def _secondary_config2(params, child_left, Xs, n_avail, n_dates=64):
     })
     log(f"config 2: {sec:.3f}s for {n_dates} min-variance solves, "
         f"solved {solved}/{n_dates}")
+
+
+def _secondary_config_serving(child_left, n_requests=1024, n_assets=24,
+                              max_batch=128):
+    """Serving config: the online solve service (porqua_tpu.serve) —
+    shape-bucketed dynamic batching over the AOT executable cache —
+    driven closed-loop with the config-5 grid shape replayed as
+    independent requests. Reports sustained throughput, latency
+    percentiles, mean batch occupancy, and the recompile count after
+    warmup (steady-state contract: 0). Runs on whatever backend the
+    child is on; the service's own circuit breaker handles a device
+    dying mid-stream by degrading to XLA-CPU."""
+    from porqua_tpu.serve.loadgen import build_tracking_requests, run_loadgen
+
+    # Scale to the budget actually left: the prewarm compiles the whole
+    # slot ladder (twice when a distinct fallback device exists) before
+    # any measurement, and a child killed mid-prewarm loses this line
+    # AND everything after it.
+    if child_left() < 150:
+        n_requests = min(n_requests, 512)
+        max_batch = min(max_batch, 64)
+    log(f"config serving ({n_requests} requests, n={n_assets}, "
+        f"max_batch={max_batch})...")
+    requests = build_tracking_requests(n_requests, n_assets=n_assets,
+                                       window=WINDOW)
+    report = run_loadgen(requests, max_batch=max_batch,
+                         inflight=4 * max_batch)
+    _emit({
+        "part": "config_serving",
+        "n_requests": n_requests,
+        "n_assets": n_assets,
+        "window": WINDOW,
+        "max_batch": max_batch,
+        "throughput_solves_per_s": round(
+            report["throughput_solves_per_s"], 1),
+        "latency_p50_ms": round(report["latency_p50_ms"], 2),
+        "latency_p99_ms": round(report["latency_p99_ms"], 2),
+        "occupancy_mean": round(report["occupancy_mean"], 4),
+        "recompiles_after_warmup": report["recompiles_after_warmup"],
+        "batches": report["batches"],
+        "solved": report["solved"],
+        "errors": report["errors"],
+        "degraded": report["degraded"],
+        "serve_device": report["device"],
+        "note": "closed-loop serve_loadgen stream through "
+                "porqua_tpu.serve.SolveService (dynamic micro-batching "
+                "+ AOT executable cache); recompiles_after_warmup==0 "
+                "is the steady-state compiled-cache contract",
+    })
+    log(f"config serving: {report['throughput_solves_per_s']:.0f} "
+        f"solves/s, p50 {report['latency_p50_ms']:.1f} ms, p99 "
+        f"{report['latency_p99_ms']:.1f} ms, occupancy "
+        f"{report['occupancy_mean']:.2f}, recompiles "
+        f"{report['recompiles_after_warmup']}")
 
 
 def _secondary_config5(params, child_left, n_bench=24, n_dates=63,

@@ -48,6 +48,7 @@ from porqua_tpu.portfolio import Portfolio, Strategy, floating_weights
 from porqua_tpu.backtest import Backtest, BacktestData, BacktestService
 from porqua_tpu.batch import (
     FIXED_UNIVERSE,
+    as_requests,
     build_problems,
     run_batch,
     solve_scan_l1,
@@ -55,6 +56,7 @@ from porqua_tpu.batch import (
     solve_scan_turnover,
 )
 from porqua_tpu.compare import compare_solvers, available_backends
+from porqua_tpu.serve import SolveService
 
 __all__ = [
     "Constraints",
@@ -89,6 +91,7 @@ __all__ = [
     "BacktestData",
     "BacktestService",
     "FIXED_UNIVERSE",
+    "as_requests",
     "build_problems",
     "run_batch",
     "solve_scan_l1",
@@ -96,4 +99,5 @@ __all__ = [
     "solve_scan_turnover",
     "compare_solvers",
     "available_backends",
+    "SolveService",
 ]

@@ -368,6 +368,21 @@ def _scan_l1_grid_jit(qp_grid: CanonicalQP, w0, l1w,
     )(qp_grid, w0)
 
 
+def as_requests(problems: BatchProblems) -> List[CanonicalQP]:
+    """Unstack a host-built batch into per-date single problems — the
+    bridge from the one-shot batched backtest to the online solve
+    service (:mod:`porqua_tpu.serve`): each date becomes an independent
+    request the micro-batcher re-coalesces with whatever else is in
+    flight. Fields are numpy views into the stacked arrays (no copy);
+    the serve bucketizer re-pads them to its own shape ladder.
+    """
+    leaves = jax.tree.map(np.asarray, problems.qp)
+    return [
+        jax.tree.map(lambda a: a[i], leaves)
+        for i in range(problems.n_dates)
+    ]
+
+
 def to_strategy(problems: BatchProblems, solution: QPSolution) -> Strategy:
     """Convert batched device results into the host ``Strategy`` object."""
     xs = np.asarray(solution.x)

@@ -10,6 +10,37 @@ different costs; the first call pays compilation), on-device counters
 reported by the solver itself (iterations, residuals — no host
 round-trips during the solve), and an optional bridge to the JAX
 profiler for TensorBoard traces.
+
+Serving metrics schema
+----------------------
+
+The online solve service (:mod:`porqua_tpu.serve`) emits JSON-lines
+snapshots (``ServeMetrics.write_jsonl`` / ``SolveService.snapshot``)
+and bridges its accumulated stage seconds into a :class:`Tracer`
+(``ServeMetrics.bridge_tracer`` -> ``serve/solve``, ``serve/compile``
+stages). One snapshot line carries:
+
+* ``t`` / ``window_seconds`` — wall clock and measurement-window age
+  (the window resets at ``ServeMetrics.reset_window``, e.g. after
+  prewarm, so ``compiles`` counts steady-state *re*compiles — 0 is the
+  compiled-cache contract).
+* request counters — ``submitted``, ``completed``, ``failed``,
+  ``expired`` (deadline passed before dispatch), ``rejected``
+  (backpressure: bounded queue full at submit).
+* batch counters — ``batches``, ``batch_slots`` (compiled slots
+  dispatched), ``batch_occupied`` (slots carrying a real request),
+  ``occupancy_mean`` = occupied/slots; ``queue_depth_mean``/``_max``
+  sampled at each dispatch.
+* cache counters — ``compiles`` (+ ``compile_seconds``),
+  ``cache_hits``, ``warm_hits`` (warm-start cache).
+* latency — ``latency_p50_ms``/``p90``/``p99``/``mean`` over a bounded
+  reservoir of per-request submit->resolve seconds.
+* solver — ``iters_mean`` (per-request device iterations),
+  ``solve_seconds`` (device dispatch wall-clock),
+  ``throughput_solves_per_s`` = completed / window.
+* health — ``device`` (current target, e.g. ``"tpu:0"``/``"cpu:0"``),
+  ``degraded`` (circuit breaker open), ``probe_failures``,
+  ``device_switches``, ``dispatch_failures``.
 """
 
 from __future__ import annotations

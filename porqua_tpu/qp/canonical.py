@@ -40,13 +40,14 @@ from typing import NamedTuple, Optional, Sequence
 import jax
 import jax.numpy as jnp
 
+import numpy as np
+
 # The one precision policy for every residual-bearing matvec/Gram in the
 # QP stack (admm, polish, canonical): HIGHEST, because the TPU MXU
 # computes f32 ``@`` in bf16 passes by default (~4e-3 relative error),
 # which perturbs iterates and floors measurable residuals; the ADMM
 # stages are memory-bound, so the extra passes cost nothing measurable.
 HP = jax.lax.Precision.HIGHEST
-import numpy as np
 
 
 class CanonicalQP(NamedTuple):
@@ -115,9 +116,9 @@ class CanonicalQP(NamedTuple):
         """0.5 x'Px + q'x (+ constant); mirrors reference
         ``qp_problems.py:219-221``. P is applied through the factor
         when present (see :meth:`apply_P`)."""
-        val = 0.5 * jnp.einsum("...i,...i->...", x, self.apply_P(x)) + jnp.einsum(
-            "...i,...i->...", self.q, x
-        )
+        val = 0.5 * jnp.einsum(
+            "...i,...i->...", x, self.apply_P(x), precision=HP
+        ) + jnp.einsum("...i,...i->...", self.q, x, precision=HP)
         return val + self.constant if with_const else val
 
     @staticmethod
@@ -226,8 +227,16 @@ class CanonicalQP(NamedTuple):
         )
 
 
-def stack_qps(qps: Sequence[CanonicalQP]) -> CanonicalQP:
-    """Stack same-shape problems into one batch along a new leading axis."""
+def stack_qps(qps: Sequence[CanonicalQP], stack_fn=None) -> CanonicalQP:
+    """Stack same-shape problems into one batch along a new leading axis.
+
+    ``stack_fn`` selects the array backend: the default ``jnp.stack``
+    places the batch on the default device (the batched-backtest path);
+    the serve batcher passes ``np.stack`` so the assembled batch stays
+    host-side numpy and the AOT executable — compiled for a *specific*
+    device — performs the one transfer itself (a jnp-stacked batch
+    committed to the wrong device would be rejected at call time).
+    """
     if not qps:
         raise ValueError("cannot stack an empty sequence of QPs")
     shapes = {(qp.n, qp.m) for qp in qps}
@@ -236,4 +245,66 @@ def stack_qps(qps: Sequence[CanonicalQP]) -> CanonicalQP:
             f"all problems must share one padded shape; got {sorted(shapes)}. "
             "Pass n_max/m_max to CanonicalQP.build."
         )
-    return jax.tree.map(lambda *xs: jnp.stack(xs), *qps)
+    stack_fn = jnp.stack if stack_fn is None else stack_fn
+    return jax.tree.map(lambda *xs: stack_fn(xs), *qps)
+
+
+def pad_qp(qp: CanonicalQP, n_max: int, m_max: int) -> CanonicalQP:
+    """Host-side re-pad of an already-built single problem to a larger
+    static shape, with the same neutrality scheme as :meth:`build`
+    (padded variables: unit diagonal, ``lb = ub = 0``; padded rows:
+    all-zero with infinite bounds; masks extended with zeros).
+
+    This is the serving bucketizer's workhorse: incoming requests carry
+    problems at their natural shape and are padded up to a small ladder
+    of shape buckets so a stream of heterogeneous problems compiles to a
+    handful of executables. Returns **numpy** fields (zero-copy when no
+    padding is needed beyond the array conversion) — batching keeps the
+    host-side representation until the one stacked device transfer.
+    """
+    n, m = qp.n, qp.m
+    if n_max < n or m_max < m:
+        raise ValueError(
+            f"padding target ({n_max},{m_max}) smaller than problem "
+            f"({n},{m})")
+    dn, dm = n_max - n, m_max - m
+    f = lambda a: np.asarray(a)
+    dtype = f(qp.q).dtype
+    if dn == 0 and dm == 0:
+        out = CanonicalQP(*(None if x is None else f(x) for x in qp))
+        if out.Pf is not None and out.Pdiag is None:
+            # Normalize the factored pytree structure: a factored
+            # problem must ALWAYS carry a Pdiag leaf after padding
+            # (the padded path materializes it, the AOT shape struct
+            # expects it, and stack_qps cannot mix None with arrays).
+            out = out._replace(Pdiag=np.zeros(n, dtype))
+        return out
+
+    P_pad = np.zeros((n_max, n_max), dtype)
+    P_pad[:n, :n] = f(qp.P)
+    if dn:
+        P_pad[n:, n:] = np.eye(dn, dtype=dtype)
+    C_pad = np.zeros((m_max, n_max), dtype)
+    C_pad[:m, :n] = f(qp.C)
+    pad_n = lambda v, fill: np.concatenate(
+        [f(v), np.full(dn, fill, dtype)]) if dn else f(v)
+    pad_m = lambda v, fill: np.concatenate(
+        [f(v), np.full(dm, fill, dtype)]) if dm else f(v)
+    Pf_pad = Pd_pad = None
+    if qp.Pf is not None:
+        # Factor rows are a capacitance dimension, never padded; only
+        # the variable axis grows. The padding block's unit diagonal
+        # lives in the diagonal completion, as in build().
+        Pf_pad = (np.concatenate(
+            [f(qp.Pf), np.zeros((f(qp.Pf).shape[0], dn), dtype)], axis=1)
+            if dn else f(qp.Pf))
+        Pd = f(qp.Pdiag) if qp.Pdiag is not None else np.zeros(n, dtype)
+        Pd_pad = np.concatenate([Pd, np.ones(dn, dtype)]) if dn else Pd
+    return CanonicalQP(
+        P=P_pad, q=pad_n(qp.q, 0.0), C=C_pad,
+        l=pad_m(qp.l, -np.inf), u=pad_m(qp.u, np.inf),
+        lb=pad_n(qp.lb, 0.0), ub=pad_n(qp.ub, 0.0),
+        var_mask=pad_n(qp.var_mask, 0.0), row_mask=pad_m(qp.row_mask, 0.0),
+        constant=f(qp.constant).astype(dtype),
+        Pf=Pf_pad, Pdiag=Pd_pad,
+    )

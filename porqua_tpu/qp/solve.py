@@ -11,6 +11,7 @@ XLA program.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 from typing import NamedTuple, Optional
 
@@ -174,6 +175,22 @@ def solve_qp(qp: CanonicalQP,
     return _solve_impl(qp, params, x0, y0, l1_weight, l1_center)
 
 
+def _solve_batch_impl(qp: CanonicalQP,
+                      params: SolverParams,
+                      x0: Optional[jax.Array] = None,
+                      y0: Optional[jax.Array] = None,
+                      l1_weight: Optional[jax.Array] = None,
+                      l1_center: Optional[jax.Array] = None) -> QPSolution:
+    """The vmapped batch solve, un-jitted — shared by the jit entry point
+    below and the AOT lowering path (:func:`aot_compile_batch`)."""
+    in_axes = tuple(None if a is None else 0
+                    for a in (qp, x0, y0, l1_weight, l1_center))
+    return jax.vmap(
+        lambda q, xx, yy, lw, lc: _solve_impl(q, params, xx, yy, lw, lc),
+        in_axes=(0,) + in_axes[1:],
+    )(qp, x0, y0, l1_weight, l1_center)
+
+
 @functools.partial(jax.jit, static_argnames=("params",))
 def solve_qp_batch(qp: CanonicalQP,
                    params: SolverParams = SolverParams(),
@@ -182,9 +199,57 @@ def solve_qp_batch(qp: CanonicalQP,
                    l1_weight: Optional[jax.Array] = None,
                    l1_center: Optional[jax.Array] = None) -> QPSolution:
     """Solve a batch of canonical QPs (leading axis) in one XLA program."""
-    in_axes = tuple(None if a is None else 0
-                    for a in (qp, x0, y0, l1_weight, l1_center))
-    return jax.vmap(
-        lambda q, xx, yy, lw, lc: _solve_impl(q, params, xx, yy, lw, lc),
-        in_axes=(0,) + in_axes[1:],
-    )(qp, x0, y0, l1_weight, l1_center)
+    return _solve_batch_impl(qp, params, x0, y0, l1_weight, l1_center)
+
+
+def batch_shape_struct(batch: int, n: int, m: int,
+                       dtype=jnp.float32,
+                       factor_rows: Optional[int] = None) -> CanonicalQP:
+    """Abstract (shape/dtype-only) ``CanonicalQP`` batch for AOT lowering.
+
+    ``factor_rows`` adds the optional low-rank objective factor
+    ``Pf (batch, r, n)`` / ``Pdiag (batch, n)`` to the pytree — the
+    factor's row count is part of the static shape, so executables for
+    factored and dense problems are distinct cache entries.
+    """
+    s = lambda *shape: jax.ShapeDtypeStruct((batch,) + shape, dtype)
+    return CanonicalQP(
+        P=s(n, n), q=s(n), C=s(m, n), l=s(m), u=s(m), lb=s(n), ub=s(n),
+        var_mask=s(n), row_mask=s(m), constant=s(),
+        Pf=None if factor_rows is None else s(factor_rows, n),
+        Pdiag=None if factor_rows is None else s(n),
+    )
+
+
+def aot_compile_batch(qp_struct: CanonicalQP,
+                      params: SolverParams = SolverParams(),
+                      device=None):
+    """AOT-compile the batch solve for one static shape: the serving
+    entry point (``jit(...).lower(...).compile()``).
+
+    The returned executable takes ``(qp, x0, y0)`` with concrete arrays
+    matching ``qp_struct`` plus ``x0 (batch, n)`` / ``y0 (batch, m)``
+    warm starts, and returns a batched :class:`QPSolution`. Warm starts
+    are ALWAYS part of the signature — ``x0=None`` and ``x0=zeros`` run
+    the identical program (``admm_solve`` initializes at zero), so one
+    executable serves both cold and warm requests and the compiled-
+    executable cache never forks on warm-start presence.
+
+    ``device`` pins compilation to a specific :class:`jax.Device`
+    (serving compiles one executable per device so the circuit breaker
+    can fall back from TPU to XLA-CPU without a recompile-on-failover
+    stall); ``None`` compiles for the default backend.
+    """
+    B = qp_struct.q.shape[0]
+    n, m = qp_struct.q.shape[-1], qp_struct.l.shape[-1]
+    dtype = qp_struct.q.dtype
+    x0_s = jax.ShapeDtypeStruct((B, n), dtype)
+    y0_s = jax.ShapeDtypeStruct((B, m), dtype)
+
+    def entry(qp, x0, y0):
+        return _solve_batch_impl(qp, params, x0, y0)
+
+    ctx = (jax.default_device(device) if device is not None
+           else contextlib.nullcontext())
+    with ctx:
+        return jax.jit(entry).lower(qp_struct, x0_s, y0_s).compile()

@@ -1,0 +1,65 @@
+"""Online QP solve service: shape-bucketed dynamic batching over the
+AOT compiled-executable cache, with device-health fallback.
+
+The batched backtest (:mod:`porqua_tpu.batch`) proved the device
+solves hundreds of shape-uniform QPs in one dispatch for barely more
+than one; this package turns that into an *online* property — a stream
+of independent solve requests is padded to a small shape-bucket
+ladder, coalesced by a micro-batcher (max-batch / max-wait policy),
+warm-started per portfolio fingerprint, and dispatched through
+executables compiled once via ``jit(...).lower(...).compile()``.
+
+    from porqua_tpu.serve import SolveService
+    with SolveService(max_batch=256, max_wait_ms=2.0) as svc:
+        svc.prewarm(example_qp)              # compile before traffic
+        t = svc.submit(qp, warm_key="fund-a")
+        res = svc.result(t, timeout=10.0)    # res.x, res.found, ...
+
+Observability: ``svc.snapshot()`` / ``ServeMetrics.write_jsonl``
+(schema in the :mod:`porqua_tpu.profiling` docstring). Load testing:
+``scripts/serve_loadgen.py`` / :func:`porqua_tpu.serve.loadgen.run_loadgen`.
+"""
+
+from porqua_tpu.serve.batcher import (
+    DeadlineExpired,
+    MicroBatcher,
+    SolveError,
+    SolveResult,
+    WarmStartCache,
+    problem_fingerprint,
+)
+from porqua_tpu.serve.bucketing import (
+    Bucket,
+    BucketLadder,
+    BucketOverflow,
+    ExecutableCache,
+    slot_count,
+    slot_ladder,
+)
+from porqua_tpu.serve.metrics import ServeMetrics
+from porqua_tpu.serve.service import (
+    DeviceHealth,
+    QueueFull,
+    SolveService,
+    Ticket,
+)
+
+__all__ = [
+    "Bucket",
+    "BucketLadder",
+    "BucketOverflow",
+    "DeadlineExpired",
+    "DeviceHealth",
+    "ExecutableCache",
+    "MicroBatcher",
+    "QueueFull",
+    "ServeMetrics",
+    "SolveError",
+    "SolveResult",
+    "SolveService",
+    "Ticket",
+    "WarmStartCache",
+    "problem_fingerprint",
+    "slot_count",
+    "slot_ladder",
+]

@@ -1,0 +1,172 @@
+"""Serving observability: counters, latency percentiles, JSON-lines.
+
+One :class:`ServeMetrics` instance is shared by the whole serve stack
+(service / batcher / executable cache / device health) and is the
+single source of truth the load generator and ``bench.py``'s
+``serving`` config read. The snapshot schema is documented in the
+:mod:`porqua_tpu.profiling` module docstring (the serve layer is that
+module's online counterpart); :meth:`ServeMetrics.bridge_tracer`
+re-exports the accumulated stage seconds into an existing
+:class:`porqua_tpu.profiling.Tracer` so serving runs land in the same
+report as one-shot benchmarks.
+
+Thread-safety: every mutator takes the instance lock — submitters run
+on caller threads, batch observations on the batcher thread, and
+snapshot readers on whichever thread polls.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+#: Counter names, so consumers can rely on every key existing (a
+#: counter that was never incremented reads 0, not KeyError).
+COUNTERS = (
+    "submitted",        # requests accepted into the queue
+    "completed",        # futures resolved with a solution
+    "failed",           # futures resolved with an error
+    "expired",          # deadline passed before dispatch
+    "rejected",         # backpressure: bounded queue full at submit
+    "batches",          # device dispatches
+    "batch_slots",      # total compiled batch slots dispatched
+    "batch_occupied",   # slots carrying a real request
+    "compiles",         # executable-cache misses (AOT compiles)
+    "cache_hits",       # executable-cache hits
+    "warm_hits",        # warm-start cache hits
+    "dispatch_failures",  # device executions that raised
+    "probe_failures",   # health probes that failed
+    "device_switches",  # circuit-breaker transitions
+)
+
+
+class ServeMetrics:
+    """Counters + reservoirs for the online solve service."""
+
+    def __init__(self, latency_reservoir: int = 65536) -> None:
+        self._lock = threading.Lock()
+        self._reservoir_cap = int(latency_reservoir)
+        self.reset_window()
+
+    def reset_window(self) -> None:
+        """Zero every counter and reservoir; the measurement window
+        restarts now. The load generator calls this after prewarm so
+        ``compiles`` counts only *re*compiles during measurement (the
+        steady-state acceptance bar is 0). Device identity/degradation
+        is service state, not window state — it survives the reset."""
+        with self._lock:
+            self.counters: Dict[str, int] = {k: 0 for k in COUNTERS}
+            self._latencies: List[float] = []
+            self._solve_seconds = 0.0
+            self._compile_seconds = 0.0
+            self._iters_sum = 0.0
+            self._iters_n = 0
+            self._queue_depth_sum = 0
+            self._queue_depth_max = 0
+            self._queue_depth_samples = 0
+            self._degraded = getattr(self, "_degraded", False)
+            self._device_label: Optional[str] = getattr(
+                self, "_device_label", None)
+            self._window_start = time.monotonic()
+
+    # -- mutators ----------------------------------------------------
+
+    def inc(self, name: str, k: int = 1) -> None:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + k
+
+    def set_device(self, label: str, degraded: bool = False) -> None:
+        with self._lock:
+            self._device_label = label
+            self._degraded = degraded
+
+    def observe_compile(self, seconds: float) -> None:
+        with self._lock:
+            self.counters["compiles"] += 1
+            self._compile_seconds += seconds
+
+    def observe_queue_depth(self, depth: int) -> None:
+        with self._lock:
+            self._queue_depth_sum += depth
+            self._queue_depth_max = max(self._queue_depth_max, depth)
+            self._queue_depth_samples += 1
+
+    def observe_batch(self, real: int, slots: int, solve_seconds: float,
+                      iters_mean: float) -> None:
+        with self._lock:
+            self.counters["batches"] += 1
+            self.counters["batch_slots"] += slots
+            self.counters["batch_occupied"] += real
+            self._solve_seconds += solve_seconds
+            self._iters_sum += iters_mean * real
+            self._iters_n += real
+
+    def observe_latency(self, seconds: float) -> None:
+        with self._lock:
+            if len(self._latencies) < self._reservoir_cap:
+                self._latencies.append(seconds)
+            else:
+                # Cheap reservoir: overwrite pseudo-uniformly; the cap
+                # is generous enough that p99 stays faithful.
+                i = self.counters["completed"] % self._reservoir_cap
+                self._latencies[i] = seconds
+
+    # -- readers -----------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        """One JSON-able dict of everything (schema: profiling.py)."""
+        with self._lock:
+            lat = np.asarray(self._latencies, dtype=np.float64)
+            c = dict(self.counters)
+            elapsed = time.monotonic() - self._window_start
+            out: Dict[str, Any] = {
+                "t": time.time(),
+                "window_seconds": elapsed,
+                **c,
+                "occupancy_mean": (c["batch_occupied"] / c["batch_slots"]
+                                   if c["batch_slots"] else 0.0),
+                "queue_depth_mean": (
+                    self._queue_depth_sum / self._queue_depth_samples
+                    if self._queue_depth_samples else 0.0),
+                "queue_depth_max": self._queue_depth_max,
+                "solve_seconds": self._solve_seconds,
+                "compile_seconds": self._compile_seconds,
+                "iters_mean": (self._iters_sum / self._iters_n
+                               if self._iters_n else 0.0),
+                "throughput_solves_per_s": (c["completed"] / elapsed
+                                            if elapsed > 0 else 0.0),
+                "degraded": self._degraded,
+                "device": self._device_label,
+            }
+            for name, pct in (("p50", 50), ("p90", 90), ("p99", 99)):
+                out[f"latency_{name}_ms"] = (
+                    float(np.percentile(lat, pct)) * 1e3 if lat.size else 0.0)
+            out["latency_mean_ms"] = float(lat.mean()) * 1e3 if lat.size else 0.0
+            return out
+
+    def write_jsonl(self, path: str) -> Dict[str, Any]:
+        """Append one snapshot line to ``path``; returns the snapshot."""
+        snap = self.snapshot()
+        with open(path, "a") as f:
+            f.write(json.dumps(snap) + "\n")
+        return snap
+
+    def bridge_tracer(self, tracer) -> None:
+        """Export the window's accumulated stage seconds into a
+        :class:`porqua_tpu.profiling.Tracer` — serving runs then render
+        through the same ``Tracer.report()`` as one-shot benchmarks."""
+        from porqua_tpu.profiling import StageTiming
+
+        snap = self.snapshot()
+        for stage, seconds in (("serve/solve", snap["solve_seconds"]),
+                               ("serve/compile", snap["compile_seconds"])):
+            tracer.timings.append(StageTiming(stage, seconds, {
+                "batches": snap["batches"],
+                "occupancy_mean": round(snap["occupancy_mean"], 4),
+                "compiles": snap["compiles"],
+            }))

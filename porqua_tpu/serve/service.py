@@ -1,0 +1,338 @@
+"""`SolveService`: the online front door, with device-health fallback.
+
+``submit()`` accepts one :class:`CanonicalQP` at its natural shape and
+returns a ticket; ``result()`` blocks on that ticket. Between the two,
+the request is padded to its shape bucket (caller thread — padding is
+host work and parallelizes across submitters), queued with
+backpressure (bounded queue; a full queue raises :class:`QueueFull`
+instead of letting latency grow without bound), coalesced by the
+micro-batcher, and solved by a pre-compiled executable on whatever
+device the health manager currently trusts.
+
+Device health is a circuit breaker because this repo's TPU transport
+is *known* to black-hole rather than fail fast (five rounds of bench
+artifacts starved by it — VERDICT.md): probes run with a hard thread
+timeout, ``failure_threshold`` consecutive failures trip the breaker,
+and a tripped service degrades to the XLA-CPU fallback device —
+requests keep completing, slower, instead of erroring. After
+``recovery_interval_s`` the primary is re-probed (half-open) and
+traffic moves back when it answers.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import NamedTuple, Optional
+
+import jax
+import numpy as np
+
+from porqua_tpu.qp.canonical import CanonicalQP
+from porqua_tpu.qp.solve import SolverParams
+from porqua_tpu.serve.batcher import (
+    DeadlineExpired,
+    MicroBatcher,
+    SolveError,
+    SolveRequest,
+    SolveResult,
+    WarmStartCache,
+    problem_fingerprint,
+)
+from porqua_tpu.serve.bucketing import BucketLadder, ExecutableCache
+from porqua_tpu.serve.metrics import ServeMetrics
+
+import queue as _queue
+
+__all__ = [
+    "DeviceHealth", "QueueFull", "SolveService", "Ticket",
+    "DeadlineExpired", "SolveError", "SolveResult",
+]
+
+
+class QueueFull(Exception):
+    """Backpressure: the bounded submission queue is full."""
+
+
+def _default_probe(device) -> bool:
+    """Liveness = one tiny dispatch AND a host round-trip on ``device``
+    (mirrors bench.py's probe: ``block_until_ready`` alone has been
+    observed returning early across the tunnel)."""
+    x = jax.device_put(np.ones((8,), np.float32), device)
+    return bool(np.asarray(x + 1.0)[0] == 2.0)
+
+
+class DeviceHealth:
+    """Probe + circuit breaker over a (primary, fallback) device pair."""
+
+    def __init__(self,
+                 primary=None,
+                 fallback=None,
+                 probe_fn=None,
+                 failure_threshold: int = 2,
+                 probe_timeout_s: float = 30.0,
+                 recovery_interval_s: float = 60.0,
+                 metrics: Optional[ServeMetrics] = None) -> None:
+        self.primary = jax.devices()[0] if primary is None else primary
+        if fallback is None:
+            try:
+                fallback = jax.devices("cpu")[0]
+            except RuntimeError:  # no CPU backend registered
+                fallback = self.primary
+        self.fallback = fallback
+        self.probe_fn = _default_probe if probe_fn is None else probe_fn
+        self.failure_threshold = int(failure_threshold)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.recovery_interval_s = float(recovery_interval_s)
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        self._failures = 0
+        self._degraded = False
+        self._opened_at = 0.0
+        self._recovery_inflight = False
+        self._publish()
+
+    # -- internals ---------------------------------------------------
+
+    def _publish(self) -> None:
+        if self.metrics is not None:
+            dev = self.fallback if self._degraded else self.primary
+            self.metrics.set_device(
+                f"{dev.platform}:{dev.id}", degraded=self._degraded)
+
+    def _probe_with_timeout(self, device) -> bool:
+        """A black-holed device HANGS probes rather than failing them;
+        run the probe on a scrap daemon thread and treat a timeout as a
+        failure (the thread is abandoned — it holds no locks)."""
+        result = []
+
+        def run():
+            try:
+                result.append(bool(self.probe_fn(device)))
+            except Exception:  # noqa: BLE001 - any fault = unhealthy
+                result.append(False)
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        t.join(self.probe_timeout_s)
+        ok = bool(result and result[0])
+        if not ok and self.metrics is not None:
+            self.metrics.inc("probe_failures")
+        return ok
+
+    def _trip(self) -> None:
+        self._degraded = True
+        self._opened_at = time.monotonic()
+        if self.metrics is not None:
+            self.metrics.inc("device_switches")
+        self._publish()
+
+    # -- API ---------------------------------------------------------
+
+    @property
+    def degraded(self) -> bool:
+        return self._degraded
+
+    def startup_check(self) -> None:
+        """Probe the primary before accepting traffic; a dead primary
+        trips the breaker immediately (requests never see the failure,
+        they just start on the fallback)."""
+        if self.primary is self.fallback:
+            return
+        with self._lock:
+            for _ in range(self.failure_threshold):
+                if self._probe_with_timeout(self.primary):
+                    return
+            self._trip()
+
+    def device(self):
+        """The device new dispatches should target. While degraded the
+        fallback is returned IMMEDIATELY; the half-open re-probe of the
+        primary runs on a background thread (a probe against the
+        black-holing primary hangs for probe_timeout_s — blocking the
+        dispatch thread on it would stall every bucket's traffic for
+        the very window the breaker exists to bridge)."""
+        with self._lock:
+            if not self._degraded:
+                return self.primary
+            if (self.primary is not self.fallback
+                    and not self._recovery_inflight
+                    and time.monotonic() - self._opened_at
+                    >= self.recovery_interval_s):
+                self._recovery_inflight = True
+                threading.Thread(target=self._try_recover,
+                                 name="porqua-serve-recovery",
+                                 daemon=True).start()
+            return self.fallback
+
+    def _try_recover(self) -> None:
+        ok = self._probe_with_timeout(self.primary)
+        with self._lock:
+            self._recovery_inflight = False
+            if not self._degraded:
+                return  # raced a concurrent close
+            if ok:
+                self._degraded = False
+                self._failures = 0
+                if self.metrics is not None:
+                    self.metrics.inc("device_switches")
+                self._publish()
+            else:
+                self._opened_at = time.monotonic()
+
+    def record_success(self) -> None:
+        with self._lock:
+            if not self._degraded:
+                self._failures = 0
+
+    def record_failure(self, exc: Exception) -> bool:
+        """Count one dispatch failure; returns True when the caller
+        should retry (the breaker tripped to a different device, or it
+        was already degraded and the fallback remains)."""
+        with self._lock:
+            if self._degraded:
+                # Already on the fallback; nothing further to fall to.
+                return False
+            self._failures += 1
+            if self._failures >= self.failure_threshold:
+                self._trip()
+                return self.primary is not self.fallback
+            return True  # transient budget left: retry on the primary
+
+
+class Ticket(NamedTuple):
+    """Handle ``submit`` returns; redeem via ``SolveService.result``."""
+
+    future: Future
+    submitted: float
+
+
+class SolveService:
+    """Online QP solve service (see module docstring)."""
+
+    def __init__(self,
+                 params: SolverParams = SolverParams(),
+                 ladder: Optional[BucketLadder] = None,
+                 max_batch: int = 64,
+                 max_wait_ms: float = 2.0,
+                 queue_capacity: int = 4096,
+                 warm_start: bool = True,
+                 warm_capacity: int = 4096,
+                 fingerprint_warm_keys: bool = False,
+                 metrics: Optional[ServeMetrics] = None,
+                 health: Optional[DeviceHealth] = None,
+                 **health_kwargs) -> None:
+        self.params = params
+        self.fingerprint_warm_keys = bool(fingerprint_warm_keys)
+        self.ladder = BucketLadder() if ladder is None else ladder
+        self.metrics = ServeMetrics() if metrics is None else metrics
+        self.health = (DeviceHealth(metrics=self.metrics, **health_kwargs)
+                       if health is None else health)
+        self.cache = ExecutableCache(params, metrics=self.metrics)
+        self.batcher = MicroBatcher(
+            self.cache, self.health, self.metrics,
+            max_batch=max_batch, max_wait_ms=max_wait_ms,
+            queue_capacity=queue_capacity,
+            warm_cache=WarmStartCache(warm_capacity) if warm_start else None)
+        self._started = False
+
+    # -- lifecycle ---------------------------------------------------
+
+    def start(self) -> "SolveService":
+        self.health.startup_check()
+        self.batcher.start()
+        self._started = True
+        return self
+
+    def stop(self, timeout: Optional[float] = 30.0) -> None:
+        if self._started:
+            self.batcher.stop(timeout=timeout)
+            self._started = False
+
+    def __enter__(self) -> "SolveService":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- request path ------------------------------------------------
+
+    def prewarm(self, example: CanonicalQP, dtype=None) -> int:
+        """Compile the full slot ladder for ``example``'s bucket, ahead
+        of traffic — on the current device AND the fallback device, so
+        a mid-stream circuit-breaker trip dispatches into an
+        already-compiled executable instead of paying the AOT compile
+        inline while requests (and their deadlines) queue behind it.
+        Returns the number of executables compiled. Serving processes
+        call this at startup so the steady-state recompile count is
+        zero by construction."""
+        bucket = self.ladder.select(example)
+        dtype = np.asarray(example.q).dtype if dtype is None else dtype
+        current = self.health.device()
+        n = self.cache.prewarm(bucket, self.batcher.max_batch, dtype,
+                               current)
+        if self.health.fallback is not current:
+            n += self.cache.prewarm(bucket, self.batcher.max_batch,
+                                    dtype, self.health.fallback)
+        # Asymmetry, on purpose: when the breaker is ALREADY open at
+        # prewarm time, only the fallback ladder compiles — AOT
+        # compilation against a black-holed primary would hang prewarm
+        # for exactly the window the breaker is bridging. A later
+        # recovery therefore pays its primary compiles lazily.
+        return n
+
+    def submit(self,
+               qp: CanonicalQP,
+               deadline_s: Optional[float] = None,
+               warm_key: Optional[str] = None,
+               timeout: Optional[float] = None) -> Ticket:
+        """Queue one problem. ``deadline_s`` is a relative deadline: a
+        request still undispatched that much later completes with
+        :class:`DeadlineExpired` instead of occupying a batch slot.
+        ``timeout`` bounds the backpressure wait for queue space
+        (``None`` blocks; expiry raises :class:`QueueFull`). With the
+        service's ``fingerprint_warm_keys=True``, a request without an
+        explicit ``warm_key`` is keyed by its feasible-set fingerprint
+        (:func:`porqua_tpu.serve.batcher.problem_fingerprint`) — repeat
+        rebalances over the same polytope warm-start automatically."""
+        if not self._started:
+            raise RuntimeError("service not started (use `with service:`)")
+        if warm_key is None and self.fingerprint_warm_keys:
+            warm_key = problem_fingerprint(qp)
+        bucket, padded = self.ladder.pad(qp)
+        now = time.monotonic()
+        req = SolveRequest(
+            qp=padded, bucket=bucket, n_orig=qp.n, m_orig=qp.m,
+            future=Future(), submitted=now,
+            deadline=None if deadline_s is None else now + deadline_s,
+            warm_key=warm_key)
+        try:
+            if timeout is None:
+                self.batcher.queue.put(req)
+            else:
+                self.batcher.queue.put(req, timeout=timeout)
+        except _queue.Full:
+            self.metrics.inc("rejected")
+            raise QueueFull(
+                f"submission queue at capacity "
+                f"({self.batcher.queue.maxsize}); shed load or raise "
+                f"queue_capacity") from None
+        self.metrics.inc("submitted")
+        return Ticket(future=req.future, submitted=now)
+
+    def result(self, ticket: Ticket,
+               timeout: Optional[float] = None) -> SolveResult:
+        """Block for one ticket's solution; raises the request's
+        terminal error (:class:`DeadlineExpired`, :class:`SolveError`)
+        or ``concurrent.futures.TimeoutError`` on ``timeout``."""
+        return ticket.future.result(timeout=timeout)
+
+    def solve(self, qp: CanonicalQP, timeout: Optional[float] = None,
+              **submit_kwargs) -> SolveResult:
+        """Convenience: submit + result."""
+        return self.result(self.submit(qp, **submit_kwargs),
+                           timeout=timeout)
+
+    def snapshot(self) -> dict:
+        return self.metrics.snapshot()

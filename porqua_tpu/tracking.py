@@ -57,7 +57,7 @@ def build_tracking_qp(X: jax.Array,
         ub=jnp.full((n,), ub, dtype),
         var_mask=jnp.ones((n,), dtype),
         row_mask=jnp.ones((1,), dtype),
-        constant=jnp.dot(y, y),
+        constant=jnp.dot(y, y, precision=hp),
         # P = 2 X'X + diag(2 ridge): expose the factor so the solver's
         # linear algebra can run in the (T+m)-dim dual space when the
         # window is shorter than the universe (linsolve="woodbury").

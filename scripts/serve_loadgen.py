@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Load generator for the online solve service (porqua_tpu.serve).
+
+Replays a stream of per-date index-replication QPs as independent
+requests through a :class:`SolveService` and reports sustained
+throughput, p50/p99 latency, mean batch occupancy, and the recompile
+count after warmup (steady-state bar: 0). Two workloads:
+
+* ``--workload grid`` (default): the config-5 MSCI-grid shape —
+  n=24 assets, 252-day windows. The serving acceptance bar on XLA-CPU
+  is >= 1,000 solves/s at >= 50% mean occupancy.
+* ``--workload northstar``: the 252-date x 500-asset stream from the
+  one-shot benchmark, re-played as 252 independent requests.
+
+Examples::
+
+    JAX_PLATFORMS=cpu python scripts/serve_loadgen.py
+    python scripts/serve_loadgen.py --workload northstar --requests 252
+    python scripts/serve_loadgen.py --mode open --rate 2000 --duration-requests 8192
+    python scripts/serve_loadgen.py --warm-keys --jsonl serve_metrics.jsonl
+
+Prints one JSON report line on stdout (diagnostics on stderr), in the
+same one-line-artifact style as ``bench.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--workload", choices=("grid", "northstar"),
+                    default="grid")
+    ap.add_argument("--requests", type=int, default=None,
+                    help="request count (default: 2048 grid / 252 northstar)")
+    ap.add_argument("--window", type=int, default=252)
+    ap.add_argument("--mode", choices=("closed", "open"), default="closed")
+    ap.add_argument("--rate", type=float, default=None,
+                    help="open-loop arrival rate, solves/s")
+    ap.add_argument("--inflight", type=int, default=None,
+                    help="closed-loop in-flight window (default 4*max-batch)")
+    ap.add_argument("--max-batch", type=int, default=256)
+    ap.add_argument("--max-wait-ms", type=float, default=2.0)
+    ap.add_argument("--warm-keys", action="store_true",
+                    help="tag requests with stream-index warm keys")
+    ap.add_argument("--deadline-s", type=float, default=None)
+    ap.add_argument("--jsonl", default=None,
+                    help="append the final metrics snapshot to this file")
+    ap.add_argument("--seed", type=int, default=5)
+    ap.add_argument("--factor", action="store_true",
+                    help="carry the low-rank objective factor (Pf = X) "
+                         "on every request, as the one-shot benchmark's "
+                         "QPs do (factored requests bucket separately)")
+    args = ap.parse_args()
+
+    from porqua_tpu.serve.loadgen import build_tracking_requests, run_loadgen
+
+    n_assets = {"grid": 24, "northstar": 500}[args.workload]
+    n_requests = args.requests or {"grid": 2048, "northstar": 252}[args.workload]
+    print(f"building {n_requests} requests "
+          f"(n={n_assets}, window={args.window})...", file=sys.stderr)
+    requests = build_tracking_requests(
+        n_requests, n_assets=n_assets, window=args.window, seed=args.seed,
+        factor=args.factor)
+
+    report = run_loadgen(
+        requests, mode=args.mode, rate=args.rate, inflight=args.inflight,
+        max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+        warm_keys=args.warm_keys, deadline_s=args.deadline_s,
+        jsonl_path=args.jsonl)
+    report["workload"] = args.workload
+    print(json.dumps(report))
+    return 0 if report["errors"] == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,14 +6,52 @@
 cd "$(dirname "$0")/.."
 PIDFILE=.tpu_queue/runner.pid
 JOBPID=.tpu_queue/current_job.pid
+# A stale pidfile can name a RECYCLED pid after a reboot/crash; verify
+# the process is actually ours before kill -9, or an unrelated process
+# inheriting the number would be killed. The runner's cmdline carries
+# tpu_queue_r04.py; a job group leader's carries the job-script path
+# (bash scripts/tpu_jobs/NN_*.sh — see tpu_queue_r04.py run_job).
+cmdline_matches() {
+  tr '\0' ' ' < "/proc/$1/cmdline" 2>/dev/null | grep -q "$2"
+}
+is_queue_proc() { cmdline_matches "$1" tpu_queue_r04.py; }
+# The job check must look at the whole process GROUP, not just the
+# leader: the bash wrapper can die while its python child wedges on
+# (holding the TPU runtime) — the exact case the kill exists for. A
+# member counts as ours if its cmdline names the job-script dir (the
+# bash leader) or it is a PYTHON process whose cwd is this repo (the
+# job children are `python ...` with cwd=ROOT, see tpu_queue_r04.py
+# run_job; requiring both keeps a bystander shell/editor that merely
+# cd'd here from matching a recycled pgid).
+group_has_queue_job() {
+  local member
+  for member in $(pgrep -g "$1" 2>/dev/null); do
+    if cmdline_matches "$member" tpu_jobs/; then return 0; fi
+    if cmdline_matches "$member" python \
+       && [[ "$(readlink -f "/proc/$member/cwd" 2>/dev/null)" == "$(pwd -P)" ]]; then
+      return 0
+    fi
+  done
+  return 1
+}
 if [[ -f $PIDFILE ]] && kill -0 "$(cat $PIDFILE)" 2>/dev/null; then
-  kill -9 "$(cat $PIDFILE)" 2>/dev/null
-  sleep 1
+  if is_queue_proc "$(cat $PIDFILE)"; then
+    kill -9 "$(cat $PIDFILE)" 2>/dev/null
+    sleep 1
+  else
+    echo "stale pidfile: pid $(cat $PIDFILE) is not the queue runner; skipping kill"
+  fi
 fi
 # A wedged in-flight job survives the runner (own process group, by
-# design) and would hold the TPU runtime across the restart.
+# design) and would hold the TPU runtime across the restart. Same
+# recycled-pid hazard: the job leads its own process group (setsid), so
+# its pgid == its pid and the cmdline check applies to the group leader.
 if [[ -f $JOBPID ]]; then
-  kill -9 -- "-$(cat $JOBPID)" 2>/dev/null
+  if group_has_queue_job "$(cat $JOBPID)"; then
+    kill -9 -- "-$(cat $JOBPID)" 2>/dev/null
+  elif kill -0 -- "-$(cat $JOBPID)" 2>/dev/null; then
+    echo "stale jobpid: group $(cat $JOBPID) is not a queue job; skipping kill"
+  fi
   rm -f $JOBPID
 fi
 mkdir -p .tpu_queue

@@ -1,14 +1,17 @@
 # TIMEOUT: 660
 # ATTEMPTS: 4
 # SUCCESS: "device": "tpu"
+# STALLFILE: .tpu_queue/bench_rehearsal.err
 # Full driver-contract rehearsal: exactly what the driver runs at end of
 # round. Warms the persistent XLA compilation cache for the TPU child so
 # the driver's own run compiles from disk, and commits the evidence.
-# stderr tees through to the runner so its stall watchdog sees the
-# bench's progress lines (stdout must stay clean JSON).
-python bench.py > BENCH_REHEARSAL_r05_tpu.json 2> >(tee .tpu_queue/bench_rehearsal.err >&2)
+# stderr goes straight to the .err file (no tee process substitution:
+# bare `wait` only reliably reaps a procsub on bash >= 5.1, and on older
+# bash the tail below raced tee's final writes). The runner's stall
+# watchdog reads the file; progress still reaches the job log via the
+# tail + cat below once the run completes.
+python bench.py > BENCH_REHEARSAL_r05_tpu.json 2> .tpu_queue/bench_rehearsal.err
 rc=$?
-wait  # for the async tee: its writes race the tail below and bash's exit
 cat BENCH_REHEARSAL_r05_tpu.json
 tail -20 .tpu_queue/bench_rehearsal.err
 exit $rc

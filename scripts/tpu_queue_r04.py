@@ -18,6 +18,11 @@ directives, parsed from leading comment lines:
     # STALL: 300          kill early if the job's merged output goes
     #                     quiet this long (default TPU_JOB_STALL_S=300;
     #                     raise for jobs with long silent phases)
+    # STALLFILE: path     (optional, ROOT-relative) a file whose growth
+    #                     also counts as liveness — for jobs that write
+    #                     their progress stream to a file instead of
+    #                     stdout (avoids the tee-procsub reaping race
+    #                     on bash < 5.1)
 
 State/markers/logs in ``.tpu_queue/`` (gitignored). Every job runs
 with a persistent XLA compilation cache (JAX_COMPILATION_CACHE_DIR)
@@ -63,10 +68,13 @@ def probe() -> bool:
 
 
 def parse_header(path):
-    cfg = {"TIMEOUT": 900, "ATTEMPTS": 3, "SUCCESS": None, "STALL": STALL_S}
+    cfg = {"TIMEOUT": 900, "ATTEMPTS": 3, "SUCCESS": None, "STALL": STALL_S,
+           "STALLFILE": None}
     with open(path) as f:
         for line in f:
-            m = re.match(r"#\s*(TIMEOUT|ATTEMPTS|SUCCESS|STALL):\s*(.+)", line)
+            m = re.match(
+                r"#\s*(TIMEOUT|ATTEMPTS|SUCCESS|STALL|STALLFILE):\s*(.+)",
+                line)
             if m:
                 k, v = m.group(1), m.group(2).strip()
                 if k in ("TIMEOUT", "ATTEMPTS", "STALL"):
@@ -154,6 +162,13 @@ def run_job(name, path, cfg):
     os.set_blocking(proc.stdout.fileno(), False)
     deadline = time.monotonic() + cfg["TIMEOUT"]
     last_out = time.monotonic()
+    # Optional `# STALLFILE: path` header: a job that redirects its
+    # progress stream to a file (e.g. bench stderr — writing the file
+    # directly avoids the tee-procsub reaping race on bash < 5.1) names
+    # it here, and growth of that file counts as liveness.
+    stall_file = (os.path.join(ROOT, cfg["STALLFILE"])
+                  if cfg["STALLFILE"] else None)
+    stall_file_state = None
     chunks = []
     rc = None
     while True:
@@ -161,6 +176,15 @@ def run_job(name, path, cfg):
         if chunk:
             chunks.append(chunk)
             last_out = time.monotonic()
+        if stall_file:
+            try:
+                st = os.stat(stall_file)
+                state = (st.st_mtime, st.st_size)
+            except OSError:
+                state = None
+            if state is not None and state != stall_file_state:
+                stall_file_state = state
+                last_out = time.monotonic()
         rc = proc.poll()
         if rc is not None:
             break
