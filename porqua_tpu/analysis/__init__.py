@@ -1,0 +1,51 @@
+"""graftcheck: static analysis + sanitizers for the device discipline.
+
+Four enforcement layers (see each submodule's docstring):
+
+* :mod:`porqua_tpu.analysis.lint` — AST rules GC001-GC005 (precision
+  pins, host-sync hazards, recompile hazards, debug hooks, import-time
+  backend init). Pure stdlib on its own (no JAX work), though the
+  parent ``porqua_tpu`` package import still runs first.
+* :mod:`porqua_tpu.analysis.guards` — GC006, the ``# guarded-by:``
+  thread-safety lint for the serving stack.
+* :mod:`porqua_tpu.analysis.contracts` — GC101-GC103, trace-time jaxpr
+  contracts on the public batch entry points (imports JAX; loaded
+  lazily so the lint path stays light).
+* :mod:`porqua_tpu.analysis.sanitize` — the ``PORQUA_SANITIZE=1``
+  runtime mode: ``jax.transfer_guard`` around solver dispatches and a
+  hard zero-recompiles-after-warmup assertion in serving.
+
+CLI: ``python scripts/run_checks.py porqua_tpu/`` (wired into
+``scripts/run_tests.sh``). Suppressions: ``# graftcheck:
+disable=GC00x`` per line, ``# graftcheck: disable-file=GC00x`` per
+file. See README "Static analysis & sanitizers".
+"""
+
+from porqua_tpu.analysis.lint import (  # noqa: F401
+    Finding,
+    RULE_DOCS,
+    scan_paths,
+)
+from porqua_tpu.analysis.guards import check_guarded_by  # noqa: F401
+from porqua_tpu.analysis import sanitize  # noqa: F401
+
+__all__ = [
+    "Finding",
+    "RULE_DOCS",
+    "scan_paths",
+    "check_guarded_by",
+    "sanitize",
+    "contracts",
+]
+
+
+def __getattr__(name):
+    # `contracts` imports porqua_tpu.qp/batch at call time; loading it
+    # lazily keeps this package free of import cycles with
+    # porqua_tpu.batch (which imports `sanitize` from here) and skips
+    # the tracer machinery when only the AST rules are wanted.
+    if name == "contracts":
+        import importlib
+
+        return importlib.import_module("porqua_tpu.analysis.contracts")
+    raise AttributeError(name)

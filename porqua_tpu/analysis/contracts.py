@@ -1,0 +1,221 @@
+"""Trace-time jaxpr contracts for the public batch entry points.
+
+The AST rules see source; these checks see what XLA will actually be
+asked to run. Each public batch entry point — ``solve_batch`` (dense
+and factored), the serving AOT executable body, ``tracking_step``, and
+``run_batch``'s device core — is traced with abstract f32 inputs via
+``jax.make_jaxpr`` and the resulting program is asserted to satisfy:
+
+GC101  **No float64 anywhere.** The TPU has no native f64; a stray
+       ``convert_element_type`` to f64 (a numpy scalar leaking into
+       the trace, an unpinned literal under x64) silently doubles
+       memory traffic on CPU and fails or emulates on TPU.
+GC102  **No callback / transfer primitives.** ``pure_callback``,
+       ``io_callback``, ``debug_callback``, infeed/outfeed and
+       ``device_put`` inside the program mean a host round-trip per
+       dispatch — exactly the per-date sync the one-XLA-program design
+       exists to eliminate (PDQP / GPU-ADMM both attribute their
+       throughput to a sync-free iteration loop).
+GC103  **Stable output dtypes.** Every output leaf is the input float
+       dtype or int32/bool — so executables cached per shape bucket
+       can never disagree about result buffers.
+
+All tracing is abstract: nothing executes, no backend kernel runs, so
+the checks are a few hundred milliseconds on CPU and safe for tier-1.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+
+from porqua_tpu.analysis.lint import Finding
+
+try:  # jax >= 0.5 moves the jaxpr types to jax.extend.core
+    from jax.core import ClosedJaxpr, Jaxpr  # type: ignore[attr-defined]
+except ImportError:  # pragma: no cover - version dependent
+    from jax.extend.core import ClosedJaxpr, Jaxpr  # type: ignore
+
+__all__ = [
+    "check_closed_jaxpr",
+    "check_entry_points",
+    "check_run_batch",
+    "solve_batch_jaxpr",
+    "serve_entry_jaxpr",
+    "tracking_jaxpr",
+]
+
+#: primitive names that imply a host round-trip or transfer
+_BANNED_EXACT = {"device_put"}
+_BANNED_SUBSTR = ("callback", "infeed", "outfeed")
+
+
+def _iter_eqns(jaxpr: Jaxpr) -> Iterable:
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for param in eqn.params.values():
+            for sub in _sub_jaxprs(param):
+                yield from _iter_eqns(sub)
+
+
+def _sub_jaxprs(param) -> Iterable[Jaxpr]:
+    if isinstance(param, ClosedJaxpr):
+        yield param.jaxpr
+    elif isinstance(param, Jaxpr):
+        yield param
+    elif isinstance(param, (tuple, list)):
+        for item in param:
+            yield from _sub_jaxprs(item)
+
+
+def _is_f64(aval) -> bool:
+    dtype = getattr(aval, "dtype", None)
+    return dtype is not None and dtype == np.float64
+
+
+def check_closed_jaxpr(closed: ClosedJaxpr, label: str,
+                       expect_float=np.float32) -> List[Finding]:
+    """Assert the GC101/GC102/GC103 contracts on one traced program."""
+    findings: List[Finding] = []
+    path = f"<jaxpr:{label}>"
+
+    def emit(rule: str, message: str) -> None:
+        findings.append(Finding(rule, path, 0, 0, message))
+
+    inputs_f64 = any(_is_f64(v.aval) for v in closed.jaxpr.invars)
+
+    seen_f64: set = set()
+    seen_banned: set = set()
+    for eqn in _iter_eqns(closed.jaxpr):
+        name = eqn.primitive.name
+        if name in _BANNED_EXACT or any(s in name for s in _BANNED_SUBSTR):
+            if name not in seen_banned:
+                seen_banned.add(name)
+                emit("GC102", f"callback/transfer primitive {name!r} inside "
+                              "the traced program: a host round-trip per "
+                              "dispatch")
+        if inputs_f64:
+            continue  # an f64 caller opted in; dtype policing is moot
+        if name == "convert_element_type" \
+                and eqn.params.get("new_dtype") == np.float64 \
+                and "convert" not in seen_f64:
+            seen_f64.add("convert")
+            emit("GC101", "convert_element_type to float64 inside a "
+                          "float32 program (numpy scalar or x64 literal "
+                          "leaking into the trace)")
+        for ov in eqn.outvars:
+            if _is_f64(getattr(ov, "aval", None)) and name not in seen_f64:
+                seen_f64.add(name)
+                emit("GC101", f"primitive {name!r} produces float64 inside "
+                              "a float32 program")
+
+    for i, ov in enumerate(closed.jaxpr.outvars):
+        aval = getattr(ov, "aval", None)
+        dtype = getattr(aval, "dtype", None)
+        if dtype is None:
+            continue
+        if dtype == np.dtype(expect_float) or dtype == np.int32 \
+                or dtype == np.bool_:
+            continue
+        if inputs_f64 and dtype == np.float64:
+            continue
+        emit("GC103", f"output {i} has dtype {dtype} (expected "
+                      f"{np.dtype(expect_float).name}/int32/bool): shape-"
+                      "bucketed executables must agree on result buffers")
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# entry-point tracers
+# ---------------------------------------------------------------------------
+
+def solve_batch_jaxpr(batch: int = 4, n: int = 16, m: int = 4,
+                      factor_rows: Optional[int] = None,
+                      params=None, dtype=np.float32) -> ClosedJaxpr:
+    """Trace the batched solve exactly as ``solve_qp_batch`` /
+    ``solve_batch`` run it (shared ``_solve_batch_impl``)."""
+    from porqua_tpu.qp.solve import (
+        SolverParams, _solve_batch_impl, batch_shape_struct)
+
+    params = SolverParams() if params is None else params
+    struct = batch_shape_struct(batch, n, m, dtype=dtype,
+                                factor_rows=factor_rows)
+    return jax.make_jaxpr(lambda qp: _solve_batch_impl(qp, params))(struct)
+
+
+def serve_entry_jaxpr(batch: int = 4, n: int = 16, m: int = 4,
+                      factor_rows: Optional[int] = None,
+                      params=None, dtype=np.float32) -> ClosedJaxpr:
+    """Trace the serving AOT executable body (the ``entry`` that
+    ``aot_compile_batch`` lowers: batch solve + warm-start inputs)."""
+    from porqua_tpu.qp.solve import (
+        SolverParams, _solve_batch_impl, batch_shape_struct)
+
+    params = SolverParams() if params is None else params
+    struct = batch_shape_struct(batch, n, m, dtype=dtype,
+                                factor_rows=factor_rows)
+    x0 = jax.ShapeDtypeStruct((batch, n), dtype)
+    y0 = jax.ShapeDtypeStruct((batch, m), dtype)
+    return jax.make_jaxpr(
+        lambda qp, xx, yy: _solve_batch_impl(qp, params, xx, yy)
+    )(struct, x0, y0)
+
+
+def tracking_jaxpr(batch: int = 2, window: int = 8, n_assets: int = 6,
+                   params=None, dtype=np.float32) -> ClosedJaxpr:
+    """Trace the flagship tracking backtest step (build + solve +
+    evaluate in one program)."""
+    from porqua_tpu.qp.solve import SolverParams
+    from porqua_tpu.tracking import tracking_step
+
+    params = SolverParams() if params is None else params
+    Xs = jax.ShapeDtypeStruct((batch, window, n_assets), dtype)
+    ys = jax.ShapeDtypeStruct((batch, window), dtype)
+    return jax.make_jaxpr(
+        lambda X, y: tracking_step(X, y, params))(Xs, ys)
+
+
+def run_batch_jaxpr(bs, params=None, dtype=np.float32) -> ClosedJaxpr:
+    """Trace ``run_batch``'s device core against a *real*
+    ``BacktestService``: the host pass (``build_problems``) runs for
+    real, then the device pass (``solve_batch``) is traced abstractly
+    over the resulting problem shapes."""
+    import dataclasses
+
+    from porqua_tpu.batch import build_problems, solve_batch
+
+    problems = build_problems(bs, dtype=dtype)
+    if params is None:
+        params = bs.optimization.solver_params(solve_dtype=dtype)
+    abstract_qp = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(np.shape(a), a.dtype), problems.qp)
+    return jax.make_jaxpr(
+        lambda qp: solve_batch(dataclasses.replace(problems, qp=qp), params)
+    )(abstract_qp)
+
+
+def check_run_batch(bs, params=None, dtype=np.float32) -> List[Finding]:
+    return check_closed_jaxpr(run_batch_jaxpr(bs, params, dtype),
+                              "run_batch", expect_float=dtype)
+
+
+def check_entry_points(dtype=np.float32,
+                       factor_rows: int = 8) -> List[Finding]:
+    """The CI sweep: every entry point reachable without market data."""
+    findings: List[Finding] = []
+    findings += check_closed_jaxpr(
+        solve_batch_jaxpr(dtype=dtype), "solve_batch", expect_float=dtype)
+    findings += check_closed_jaxpr(
+        solve_batch_jaxpr(factor_rows=factor_rows, dtype=dtype),
+        "solve_batch[factored]", expect_float=dtype)
+    findings += check_closed_jaxpr(
+        serve_entry_jaxpr(dtype=dtype), "serve_entry", expect_float=dtype)
+    findings += check_closed_jaxpr(
+        serve_entry_jaxpr(factor_rows=factor_rows, dtype=dtype),
+        "serve_entry[factored]", expect_float=dtype)
+    findings += check_closed_jaxpr(
+        tracking_jaxpr(dtype=dtype), "tracking_step", expect_float=dtype)
+    return findings

@@ -1,0 +1,829 @@
+"""graftcheck: JAX-aware AST lint rules for the device-discipline invariants.
+
+The whole TPU rebuild rests on conventions no Python runtime enforces:
+every objective-bearing contraction pinned to ``Precision.HIGHEST``
+(the MXU computes f32 matmuls in bf16 passes by default — README,
+round-4 chip findings), zero host<->device syncs inside jit-reachable
+code, zero steady-state recompiles in the serving path, and no
+backend-initializing work at import time. Round-5 review found three
+fresh precision-pin violations in freshly written code — human review
+does not scale, so this module makes the conventions machine-checked.
+
+Rules (each suppressible per line with ``# graftcheck: disable=GC00x``
+or per file with ``# graftcheck: disable-file=GC00x``):
+
+GC001  Unpinned contraction (``jnp.dot``/``einsum``/``matmul``/
+       ``tensordot``/``inner``/``vdot`` without ``precision=``, or the
+       ``@`` operator on jnp-derived operands) inside the precision-
+       policy modules: ``qp/``, ``tracking.py``, ``estimators/``,
+       ``accounting.py``. Host numpy contractions are exempt (numpy
+       computes f32 at full precision; the rule tracks jnp taint).
+GC002  Host-sync hazard inside jit-reachable code: ``.item()``,
+       ``.block_until_ready()``, ``float()/int()/bool()`` on non-
+       literals, host ``np.*`` calls, ``jax.device_get``. Jit-reachable
+       is computed, not guessed: functions decorated with / passed to
+       ``jax.jit``/``vmap``/``pmap``/``grad``/``lax.scan`` etc. are
+       roots, and the rule walks the call graph (same-module names,
+       ``from x import y`` bindings, module-alias attributes) across
+       every file in the scan.
+GC003  Recompile hazard: ``jax.jit`` constructed inside a loop
+       (anywhere), or inside a function body in a steady-state module
+       (``qp/``, ``serve/``, ``ops/``, ``tracking.py``, ``batch.py``,
+       ``backtest.py``, ``accounting.py``) without a caching idiom —
+       immediate ``.lower(...)`` (the AOT path) and assignment to a
+       ``self.`` attribute are exempt; ``static_argnames`` naming a
+       parameter whose default is an unhashable literal; f-strings
+       interpolating ``.shape`` inside jitted code (outside
+       ``raise``/``assert``).
+GC004  Stray debug hooks in library code: ``jax.debug.print``,
+       ``jax.debug.breakpoint``, builtin ``breakpoint()``.
+GC005  Module-level calls that initialize a JAX backend at import time:
+       any ``jnp.*`` call, ``jax.devices``/``device_put``/
+       ``device_count``/``default_backend``, ``jax.random.*`` executed
+       at module scope (including class bodies, decorator expressions
+       and default-argument values). ``jax.jit``/``vmap`` at module
+       scope stay exempt — they are lazy and are the *recommended*
+       caching pattern.
+
+GC006 (the ``# guarded-by:`` thread-safety lint) lives in
+:mod:`porqua_tpu.analysis.guards`; GC101-GC103 (trace-time jaxpr
+contracts) live in :mod:`porqua_tpu.analysis.contracts`. This module's
+own code is pure stdlib ``ast`` — it adds no JAX work of its own,
+though reaching it through the package path still executes
+``porqua_tpu/__init__`` (which imports the solver stack).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+__all__ = [
+    "Finding",
+    "ModuleInfo",
+    "RULE_DOCS",
+    "iter_py_files",
+    "load_module",
+    "scan_paths",
+]
+
+RULE_DOCS = {
+    "GC001": "unpinned contraction in a precision-policy module",
+    "GC002": "host-device sync hazard in jit-reachable code",
+    "GC003": "recompile hazard",
+    "GC004": "stray debug hook in library code",
+    "GC005": "backend-initializing work at module import time",
+    "GC006": "guarded-by attribute mutated without its lock",
+    "GC101": "float64 leaked into a traced program",
+    "GC102": "callback/transfer primitive inside a traced program",
+    "GC103": "unstable output dtype in a traced program",
+}
+
+_CONTRACTIONS = {"dot", "einsum", "matmul", "tensordot", "inner", "vdot"}
+_JIT_WRAPPERS = {"jit", "vmap", "pmap", "grad", "value_and_grad",
+                 "checkpoint", "remat"}
+_LAX_CONTROL = {"scan", "while_loop", "fori_loop", "cond", "switch", "map",
+                "associative_scan"}
+_CAST_BUILTINS = {"float", "int", "bool"}
+# numpy attribute calls that only *name* a dtype are still host
+# conversions when called — no exemptions; attribute references
+# (``np.float32`` as a dtype argument) are not calls and never flagged.
+
+_SUPPRESS_LINE_RE = re.compile(
+    r"#\s*graftcheck:\s*disable\s*=\s*([A-Za-z0-9_,\s]+)")
+_SUPPRESS_FILE_RE = re.compile(
+    r"#\s*graftcheck:\s*disable-file\s*=\s*([A-Za-z0-9_,\s]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation, anchored to a file line."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class ModuleInfo:
+    """One parsed file plus everything the rules need: import aliases,
+    suppression tables, and parent links on every AST node."""
+
+    def __init__(self, path: str, source: str) -> None:
+        self.path = path
+        self.posix = "/" + path.replace(os.sep, "/").lstrip("/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                child._gc_parent = node  # type: ignore[attr-defined]
+
+        self.jnp_aliases: Set[str] = set()
+        self.np_aliases: Set[str] = set()
+        self.jax_aliases: Set[str] = set()
+        self.partial_names: Set[str] = set()
+        self.functools_aliases: Set[str] = set()
+        #: ``from pkg.mod import name as alias`` -> alias: (pkg.mod, name)
+        self.imported_from: Dict[str, Tuple[str, str]] = {}
+        #: ``import pkg.mod as alias`` -> alias: pkg.mod
+        self.module_aliases: Dict[str, str] = {}
+        self._collect_imports()
+
+        self.file_suppress: Set[str] = set()
+        self.line_suppress: Dict[int, Set[str]] = {}
+        self._collect_suppressions()
+
+        #: name -> function/async defs bound to it anywhere in the file
+        self.defs_by_name: Dict[str, List[ast.AST]] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs_by_name.setdefault(node.name, []).append(node)
+
+    # -- imports -----------------------------------------------------
+
+    def _collect_imports(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    name, bound = alias.name, alias.asname or alias.name
+                    if name == "jax.numpy":
+                        self.jnp_aliases.add(bound)
+                    elif name == "numpy":
+                        self.np_aliases.add(bound)
+                    elif name == "jax":
+                        self.jax_aliases.add(bound)
+                    elif name == "functools":
+                        self.functools_aliases.add(bound)
+                    if "." in name and alias.asname:
+                        self.module_aliases[bound] = name
+                    elif "." not in name:
+                        self.module_aliases.setdefault(bound, name)
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    bound = alias.asname or alias.name
+                    if node.module == "jax" and alias.name == "numpy":
+                        self.jnp_aliases.add(bound)
+                    elif node.module == "functools" and alias.name == "partial":
+                        self.partial_names.add(bound)
+                    self.imported_from[bound] = (node.module, alias.name)
+
+    # -- suppressions ------------------------------------------------
+
+    def _collect_suppressions(self) -> None:
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_FILE_RE.search(line)
+            if m:
+                self.file_suppress |= _parse_rule_list(m.group(1))
+            m = _SUPPRESS_LINE_RE.search(line)
+            if m:
+                self.line_suppress.setdefault(i, set()).update(
+                    _parse_rule_list(m.group(1)))
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        for pool in (self.file_suppress, self.line_suppress.get(line, ())):
+            if "all" in pool or rule in pool:
+                return True
+        return False
+
+    # -- chain helpers -----------------------------------------------
+
+    def attr_chain(self, node: ast.AST) -> Optional[List[str]]:
+        """``jax.lax.scan`` -> ['jax', 'lax', 'scan']; None when the
+        expression is not a pure Name/Attribute chain."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            parts.append(node.id)
+            return parts[::-1]
+        return None
+
+    def is_jnp_attr(self, node: ast.AST,
+                    attrs: Optional[Set[str]] = None) -> bool:
+        """True for ``jnp.X`` / ``jax.numpy.X`` (X restricted to
+        ``attrs`` when given)."""
+        chain = self.attr_chain(node)
+        if not chain or len(chain) < 2:
+            return False
+        head, tail = chain[:-1], chain[-1]
+        if attrs is not None and tail not in attrs:
+            return False
+        if len(head) == 1 and head[0] in self.jnp_aliases:
+            return True
+        return (len(head) == 2 and head[0] in self.jax_aliases
+                and head[1] == "numpy")
+
+    def mentions_jnp(self, node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and (
+                    sub.id in self.jnp_aliases):
+                return True
+            if isinstance(sub, ast.Attribute):
+                chain = self.attr_chain(sub)
+                if chain and len(chain) >= 2 and chain[0] in self.jax_aliases \
+                        and chain[1] == "numpy":
+                    return True
+        return False
+
+    def _chain_is_jax_name(self, chain: Optional[List[str]],
+                           names: Set[str],
+                           lax_names: Optional[Set[str]] = None) -> bool:
+        """Does ``chain`` denote ``jax.<name>`` for ``name in names``
+        (or ``jax.lax.<name>`` for ``lax_names``), under any import
+        style — ``jax.jit``, ``from jax import jit [as j]``,
+        ``from jax import lax; lax.scan``, ``from jax.lax import
+        scan``?"""
+        if not chain:
+            return False
+        head = self.imported_from.get(chain[0])
+        if head is not None:
+            src, orig = head
+            chain = src.split(".") + [orig] + chain[1:]
+        elif chain[0] in self.jax_aliases:
+            chain = ["jax"] + chain[1:]
+        if chain[0] != "jax":
+            return False
+        if len(chain) == 2 and chain[1] in names:
+            return True
+        return bool(lax_names and len(chain) == 3 and chain[1] == "lax"
+                    and chain[2] in lax_names)
+
+    def is_jit_constructor(self, call: ast.Call) -> bool:
+        """``jax.jit(...)`` / ``jit(...)`` (from-import) or
+        ``partial(jax.jit, ...)`` / ``functools.partial(jax.jit, ...)``."""
+        if self._chain_is_jax_name(self.attr_chain(call.func), {"jit"}):
+            return True
+        if self._is_partial(call) and call.args:
+            return self._chain_is_jax_name(
+                self.attr_chain(call.args[0]), {"jit"})
+        return False
+
+    def _is_partial(self, call: ast.Call) -> bool:
+        chain = self.attr_chain(call.func)
+        if not chain:
+            return False
+        if len(chain) == 1 and chain[0] in self.partial_names:
+            return True
+        return (len(chain) == 2 and chain[0] in self.functools_aliases
+                and chain[1] == "partial")
+
+
+def _parse_rule_list(raw: str) -> Set[str]:
+    return {tok.strip() for tok in raw.split(",") if tok.strip()}
+
+
+def _ancestors(node: ast.AST) -> Iterable[ast.AST]:
+    node = getattr(node, "_gc_parent", None)
+    while node is not None:
+        yield node
+        node = getattr(node, "_gc_parent", None)
+
+
+# ---------------------------------------------------------------------------
+# path scoping
+# ---------------------------------------------------------------------------
+
+def in_precision_scope(posix_path: str) -> bool:
+    p = posix_path
+    return ("/qp/" in p or "/estimators/" in p
+            or p.endswith("/tracking.py") or p.endswith("/accounting.py"))
+
+
+def in_steady_state_scope(posix_path: str) -> bool:
+    p = posix_path
+    return ("/qp/" in p or "/serve/" in p or "/ops/" in p
+            or p.endswith("/tracking.py") or p.endswith("/batch.py")
+            or p.endswith("/backtest.py") or p.endswith("/accounting.py"))
+
+
+def in_library_scope(posix_path: str) -> bool:
+    p = posix_path
+    return not ("/tests/" in p or "/scripts/" in p or "/examples/" in p)
+
+
+# ---------------------------------------------------------------------------
+# GC001 — unpinned contractions
+# ---------------------------------------------------------------------------
+
+def _check_gc001(mod: ModuleInfo,
+                 reachable_ids: Optional[Set[int]] = None) -> List[Finding]:
+    if not in_precision_scope(mod.posix):
+        return []
+    reachable_ids = reachable_ids or set()
+    out: List[Finding] = []
+
+    def emit(node: ast.AST, what: str) -> None:
+        if not mod.suppressed("GC001", node.lineno):
+            out.append(Finding(
+                "GC001", mod.path, node.lineno, node.col_offset,
+                f"{what} without precision= in a precision-policy module; "
+                "pin to jax.lax.Precision.HIGHEST (policy: qp/canonical.HP)"))
+
+    # Unpinned jnp contraction calls.
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and mod.is_jnp_attr(
+                node.func, _CONTRACTIONS):
+            if not any(kw.arg == "precision" for kw in node.keywords):
+                name = mod.attr_chain(node.func)[-1]
+                emit(node, f"jnp.{name}()")
+
+    # `@` on jnp-derived operands: taint names assigned from jnp
+    # expressions within their enclosing function scope, then flag
+    # MatMult whose operand is tainted or directly mentions jnp. Host
+    # numpy `@` (e.g. qp/ipm.py, CanonicalQP.build) stays exempt by
+    # construction.
+    def scope_of(node: ast.AST) -> ast.AST:
+        for a in _ancestors(node):
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                return a
+        return mod.tree
+
+    taint_cache: Dict[int, Set[str]] = {}
+
+    def tainted_names(scope: ast.AST) -> Set[str]:
+        cached = taint_cache.get(id(scope))
+        if cached is not None:
+            return cached
+        tainted: Set[str] = set()
+        for node in ast.walk(scope):
+            if not isinstance(node, (ast.Assign, ast.AugAssign,
+                                     ast.AnnAssign)):
+                continue
+            if scope_of(node) is not scope:
+                continue
+            value = node.value
+            if value is None or not mod.mentions_jnp(value):
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                for leaf in ast.walk(t):
+                    if isinstance(leaf, ast.Name):
+                        tainted.add(leaf.id)
+        taint_cache[id(scope)] = tainted
+        return tainted
+
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.BinOp)
+                and isinstance(node.op, ast.MatMult)):
+            continue
+        scope = scope_of(node)
+        tainted = tainted_names(scope)
+
+        def is_jnp_operand(op: ast.AST) -> bool:
+            if isinstance(op, ast.Name) and op.id in tainted:
+                return True
+            return mod.mentions_jnp(op)
+
+        # Inside a jit-reachable function every operand is traced (a
+        # numpy constant operand still lowers to a device matmul), so
+        # `@` on plain parameters is flagged too — the taint heuristic
+        # alone would miss exactly the hot-path case the rule exists
+        # for.
+        if id(scope) in reachable_ids \
+                or is_jnp_operand(node.left) or is_jnp_operand(node.right):
+            emit(node, "the @ operator on a jnp array")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# GC002 — host-sync hazards in jit-reachable code
+# ---------------------------------------------------------------------------
+
+class _Reachability:
+    """Cross-module jit-reachability: roots are functions decorated
+    with / passed to JAX tracing wrappers; edges follow plain-name
+    calls (same module + ``from x import y`` bindings + module-alias
+    attributes) and bare-method calls (same module)."""
+
+    def __init__(self, mods: Sequence[ModuleInfo]) -> None:
+        self.mods = mods
+        self.by_modname: Dict[str, ModuleInfo] = {}
+        for m in mods:
+            dotted = m.posix.lstrip("/").removesuffix(".py").replace("/", ".")
+            self.by_modname[dotted] = m
+        #: reachable (mod, function-or-lambda node) pairs
+        self.reached: Set[Tuple[int, int]] = set()
+        self.work: List[Tuple[ModuleInfo, ast.AST]] = []
+
+    def _module_for(self, dotted: str) -> Optional[ModuleInfo]:
+        if dotted in self.by_modname:
+            return self.by_modname[dotted]
+        # Tolerate roots scanned from a subdirectory: match on suffix.
+        for name, m in self.by_modname.items():
+            if name.endswith("." + dotted) or dotted.endswith("." + name):
+                return m
+        return None
+
+    def _add(self, mod: ModuleInfo, node: ast.AST) -> None:
+        key = (id(mod), id(node))
+        if key not in self.reached:
+            self.reached.add(key)
+            self.work.append((mod, node))
+
+    def _add_callable_expr(self, mod: ModuleInfo, expr: ast.AST) -> None:
+        if isinstance(expr, ast.Lambda):
+            self._add(mod, expr)
+        elif isinstance(expr, ast.Name):
+            self._resolve_name(mod, expr.id)
+        elif isinstance(expr, ast.Call):
+            # partial(f, ...) / jax.tree_util wrappers: dig into args.
+            for a in expr.args:
+                self._add_callable_expr(mod, a)
+
+    def _resolve_name(self, mod: ModuleInfo, name: str) -> None:
+        for node in mod.defs_by_name.get(name, ()):
+            self._add(mod, node)
+        if name in mod.imported_from:
+            src_mod, orig = mod.imported_from[name]
+            target = self._module_for(src_mod)
+            if target is not None:
+                for node in target.defs_by_name.get(orig, ()):
+                    self._add(target, node)
+
+    def _resolve_call(self, mod: ModuleInfo, call: ast.Call) -> None:
+        func = call.func
+        if isinstance(func, ast.Name):
+            self._resolve_name(mod, func.id)
+            return
+        chain = mod.attr_chain(func)
+        if chain and len(chain) == 2 and chain[0] in mod.module_aliases:
+            target = self._module_for(mod.module_aliases[chain[0]])
+            if target is not None:
+                for node in target.defs_by_name.get(chain[1], ()):
+                    self._add(target, node)
+                return
+        if isinstance(func, ast.Attribute):
+            # Bare-method call (self.foo(...), qp.foo(...)): resolve to
+            # same-module defs only — cross-module method resolution by
+            # bare name would be collision-prone.
+            for node in mod.defs_by_name.get(func.attr, ()):
+                self._add(mod, node)
+
+    def collect_roots(self) -> None:
+        for mod in self.mods:
+            for node in ast.walk(mod.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    for dec in node.decorator_list:
+                        if self._is_tracing_wrapper(mod, dec):
+                            self._add(mod, node)
+                elif isinstance(node, ast.Call) \
+                        and self._is_tracing_wrapper(mod, node.func,
+                                                     call=node):
+                    for arg in list(node.args) + [
+                            kw.value for kw in node.keywords]:
+                        self._add_callable_expr(mod, arg)
+
+    def _is_tracing_wrapper(self, mod: ModuleInfo, node: ast.AST,
+                            call: Optional[ast.Call] = None) -> bool:
+        if isinstance(node, ast.Call):
+            # @functools.partial(jax.jit, ...) decorator form
+            if mod.is_jit_constructor(node):
+                return True
+            return self._is_tracing_wrapper(mod, node.func, call=node)
+        return mod._chain_is_jax_name(
+            mod.attr_chain(node), _JIT_WRAPPERS, _LAX_CONTROL)
+
+    def run(self) -> Dict[int, Set[int]]:
+        self.collect_roots()
+        while self.work:
+            mod, node = self.work.pop()
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    self._resolve_call(mod, sub)
+        per_mod: Dict[int, Set[int]] = {}
+        for mod_id, node_id in self.reached:
+            per_mod.setdefault(mod_id, set()).add(node_id)
+        return per_mod
+
+
+def _check_gc002(mods: Sequence[ModuleInfo],
+                 reached: Dict[int, Set[int]]) -> List[Finding]:
+    out: List[Finding] = []
+    for mod in mods:
+        node_ids = reached.get(id(mod), set())
+        if not node_ids:
+            continue
+        nodes = [n for n in ast.walk(mod.tree) if id(n) in node_ids]
+        seen_lines: Set[Tuple[str, int]] = set()
+
+        def emit(node: ast.AST, what: str) -> None:
+            key = (what, node.lineno)
+            if key in seen_lines or mod.suppressed("GC002", node.lineno):
+                return
+            seen_lines.add(key)
+            out.append(Finding(
+                "GC002", mod.path, node.lineno, node.col_offset,
+                f"{what} in jit-reachable code forces a host-device sync "
+                "(or fails at trace time); keep the hot path device-only"))
+
+        for fn in nodes:
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                if isinstance(func, ast.Attribute):
+                    if func.attr == "item" and not node.args:
+                        emit(node, ".item()")
+                    elif func.attr == "block_until_ready":
+                        emit(node, ".block_until_ready()")
+                chain = mod.attr_chain(func)
+                if chain:
+                    if chain[0] in mod.np_aliases:
+                        emit(node, f"host numpy call np.{'.'.join(chain[1:])}()")
+                    elif len(chain) == 2 and chain[0] in mod.jax_aliases \
+                            and chain[1] == "device_get":
+                        emit(node, "jax.device_get()")
+                if isinstance(func, ast.Name) and func.id in _CAST_BUILTINS \
+                        and len(node.args) == 1 \
+                        and not isinstance(node.args[0], ast.Constant):
+                    emit(node, f"{func.id}() on a (possibly traced) array")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# GC003 — recompile hazards
+# ---------------------------------------------------------------------------
+
+def _check_gc003(mod: ModuleInfo) -> List[Finding]:
+    out: List[Finding] = []
+    steady = in_steady_state_scope(mod.posix)
+
+    def emit(node: ast.AST, msg: str) -> None:
+        if not mod.suppressed("GC003", node.lineno):
+            out.append(Finding("GC003", mod.path, node.lineno,
+                               node.col_offset, msg))
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and mod.is_jit_constructor(node):
+            in_loop = any(isinstance(a, (ast.For, ast.While))
+                          for a in _ancestors(node))
+            enclosing_fn = next(
+                (a for a in _ancestors(node)
+                 if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.Lambda))), None)
+            is_decorator = any(
+                isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node in getattr(a, "decorator_list", ())
+                for a in [getattr(node, "_gc_parent", None)] if a is not None)
+            parent = getattr(node, "_gc_parent", None)
+            lowered = (isinstance(parent, ast.Attribute)
+                       and parent.attr == "lower")
+            cached_on_self = (
+                isinstance(parent, ast.Assign)
+                and any(isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                        for t in parent.targets))
+            if in_loop:
+                emit(node, "jax.jit constructed inside a loop recompiles "
+                           "every iteration; hoist it to module scope")
+            elif steady and enclosing_fn is not None and not is_decorator \
+                    and not lowered and not cached_on_self:
+                emit(node, "jax.jit constructed inside a function in a "
+                           "steady-state module recompiles on every call; "
+                           "cache it at module scope, on self, or use the "
+                           "AOT .lower(...).compile() path")
+
+            # Unhashable defaults behind static_argnames.
+            static_names: Set[str] = set()
+            for kw in node.keywords:
+                if kw.arg == "static_argnames":
+                    for leaf in ast.walk(kw.value):
+                        if isinstance(leaf, ast.Constant) \
+                                and isinstance(leaf.value, str):
+                            static_names.add(leaf.value)
+            target_fn = None
+            grandparent = getattr(node, "_gc_parent", None)
+            if isinstance(grandparent, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)) \
+                    and node in grandparent.decorator_list:
+                target_fn = grandparent
+            elif node.args and isinstance(node.args[0], ast.Name):
+                defs = mod.defs_by_name.get(node.args[0].id)
+                target_fn = defs[0] if defs else None
+            elif node.args and isinstance(node.args[0], ast.Attribute):
+                pass  # jax.jit(partial) of foreign callables: unknowable
+            if static_names and target_fn is not None:
+                args = target_fn.args
+                pos = args.posonlyargs + args.args
+                defaults = args.defaults
+                offset = len(pos) - len(defaults)
+                pairs = [(a.arg, d) for a, d in zip(pos[offset:], defaults)]
+                pairs += [(a.arg, d) for a, d in
+                          zip(args.kwonlyargs, args.kw_defaults) if d]
+                for name, default in pairs:
+                    if name in static_names and isinstance(
+                            default, (ast.List, ast.Dict, ast.Set,
+                                      ast.ListComp, ast.DictComp,
+                                      ast.SetComp)):
+                        emit(default,
+                             f"static arg {name!r} has an unhashable "
+                             f"default ({type(default).__name__.lower()}); "
+                             "jit will raise or recompile per call")
+
+    # f-strings interpolating .shape inside jit-decorated functions.
+    jitted_fns = [
+        n for n in ast.walk(mod.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and any(_is_jit_decorator(mod, d) for d in n.decorator_list)]
+    for fn in jitted_fns:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.JoinedStr):
+                continue
+            if any(isinstance(a, (ast.Raise, ast.Assert))
+                   for a in _ancestors(node)):
+                continue
+            for val in node.values:
+                if isinstance(val, ast.FormattedValue) and ".shape" in \
+                        ast.unparse(val.value):
+                    emit(node, "f-string interpolating .shape inside a "
+                               "jitted function bakes the shape into a "
+                               "Python string at trace time — a silent "
+                               "per-shape recompile anchor")
+                    break
+    return out
+
+
+def _is_jit_decorator(mod: ModuleInfo, dec: ast.AST) -> bool:
+    if isinstance(dec, ast.Call):
+        return mod.is_jit_constructor(dec)
+    return mod._chain_is_jax_name(mod.attr_chain(dec), {"jit"})
+
+
+# ---------------------------------------------------------------------------
+# GC004 — stray debug hooks
+# ---------------------------------------------------------------------------
+
+def _check_gc004(mod: ModuleInfo) -> List[Finding]:
+    if not in_library_scope(mod.posix):
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = mod.attr_chain(node.func)
+        msg = None
+        if chain and len(chain) == 3 and chain[0] in mod.jax_aliases \
+                and chain[1] == "debug" and chain[2] in ("print",
+                                                         "breakpoint"):
+            msg = f"jax.debug.{chain[2]}() left in library code"
+        elif isinstance(node.func, ast.Name) and node.func.id == "breakpoint":
+            msg = "breakpoint() left in library code"
+        if msg and not mod.suppressed("GC004", node.lineno):
+            out.append(Finding("GC004", mod.path, node.lineno,
+                               node.col_offset, msg))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# GC005 — backend init at import time
+# ---------------------------------------------------------------------------
+
+_JAX_EAGER = {"devices", "local_devices", "device_count",
+              "local_device_count", "device_put", "default_backend"}
+
+
+def _module_level_exprs(tree: ast.Module) -> Iterable[ast.AST]:
+    """Every expression evaluated at import time: module/class-body
+    statements, plus decorator lists and default-argument values of
+    module-level defs (their *bodies* are not executed at import)."""
+    stack: List[ast.AST] = [tree]
+    while stack:
+        scope = stack.pop()
+        for stmt in scope.body:
+            if isinstance(stmt, ast.ClassDef):
+                yield from stmt.decorator_list
+                yield from stmt.bases
+                stack.append(stmt)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from stmt.decorator_list
+                yield from (d for d in stmt.args.defaults)
+                yield from (d for d in stmt.args.kw_defaults if d)
+            else:
+                yield stmt
+
+
+def _runs_later(node: ast.AST) -> bool:
+    """True when ``node`` sits in a function or lambda *body* (runs at
+    call time), even if the enclosing def is itself nested inside a
+    module-level compound statement (``try:``/``if:`` fallbacks).
+    Decorator expressions and default-argument values are NOT bodies —
+    they execute when the def is, so they stay import-time when the
+    def is at module level."""
+    child = node
+    for anc in _ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and child in anc.body:
+            return True
+        if isinstance(anc, ast.Lambda) and child is anc.body:
+            return True
+        child = anc
+    return False
+
+
+def _check_gc005(mod: ModuleInfo) -> List[Finding]:
+    out: List[Finding] = []
+    for expr in _module_level_exprs(mod.tree):
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            if _runs_later(node):
+                continue
+            chain = mod.attr_chain(node.func)
+            if not chain:
+                continue
+            msg = None
+            if mod.is_jnp_attr(node.func):
+                msg = (f"module-level jnp.{chain[-1]}() initializes a JAX "
+                       "backend at import time; build arrays lazily")
+            elif chain[0] in mod.jax_aliases and len(chain) == 2 \
+                    and chain[1] in _JAX_EAGER:
+                msg = (f"module-level jax.{chain[1]}() initializes a JAX "
+                       "backend at import time")
+            elif chain[0] in mod.jax_aliases and len(chain) >= 3 \
+                    and chain[1] == "random":
+                msg = ("module-level jax.random call initializes a JAX "
+                       "backend at import time")
+            if msg and not mod.suppressed("GC005", node.lineno):
+                out.append(Finding("GC005", mod.path, node.lineno,
+                                   node.col_offset, msg))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# engine
+# ---------------------------------------------------------------------------
+
+def iter_py_files(paths: Sequence[str]) -> List[str]:
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git"))
+                files.extend(os.path.join(root, n)
+                             for n in sorted(names) if n.endswith(".py"))
+        elif p.endswith(".py"):
+            files.append(p)
+    return files
+
+
+def load_module(path: str) -> ModuleInfo:
+    with open(path, "r", encoding="utf-8") as fh:
+        return ModuleInfo(path, fh.read())
+
+
+def scan_paths(paths: Sequence[str],
+               rules: Optional[Set[str]] = None) -> List[Finding]:
+    """Run every AST rule (GC001-GC006) over ``paths`` (files or
+    directory trees). ``rules`` restricts to a subset of rule ids."""
+    mods: List[ModuleInfo] = []
+    findings: List[Finding] = []
+    for path in iter_py_files(paths):
+        try:
+            mods.append(load_module(path))
+        except SyntaxError as exc:
+            findings.append(Finding(
+                "GC000", path, exc.lineno or 0, exc.offset or 0,
+                f"file does not parse: {exc.msg}"))
+
+    def want(rule: str) -> bool:
+        return rules is None or rule in rules
+
+    # GC001 (the `@`-in-jit-reachable-code case) and GC002 share the
+    # cross-module reachability pass.
+    reached: Dict[int, Set[int]] = {}
+    if want("GC001") or want("GC002"):
+        reached = _Reachability(mods).run()
+
+    for mod in mods:
+        if want("GC001"):
+            findings.extend(_check_gc001(mod, reached.get(id(mod))))
+        if want("GC003"):
+            findings.extend(_check_gc003(mod))
+        if want("GC004"):
+            findings.extend(_check_gc004(mod))
+        if want("GC005"):
+            findings.extend(_check_gc005(mod))
+    if want("GC002"):
+        findings.extend(_check_gc002(mods, reached))
+    if want("GC006"):
+        from porqua_tpu.analysis.guards import check_guarded_by
+        for mod in mods:
+            findings.extend(check_guarded_by(mod))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings

@@ -1,0 +1,160 @@
+"""Runtime sanitizer mode (``PORQUA_SANITIZE=1``).
+
+Static rules catch what is visible in source; two device-discipline
+invariants are only observable at runtime:
+
+* **No implicit host<->device transfers in solver hot paths.** Under
+  sanitize mode the batched-solve dispatch sites wrap the device call
+  in ``jax.transfer_guard("disallow")`` — any *implicit* transfer
+  (e.g. a stray numpy array reaching a compiled executable, or a
+  hidden device->host fetch inside the dispatch path) raises instead
+  of silently serializing the pipeline. Explicit ``jax.device_put``
+  remains allowed, so the serving batcher's one intentional
+  host->device batch transfer is made explicit and everything else is
+  an error.
+
+* **Zero steady-state recompiles.** The serving executable cache calls
+  :func:`note_compile` on every AOT compile, passing its own per-cache
+  warmed flag (closed by ``ExecutableCache.prewarm`` /
+  ``SolveService.prewarm``); once closed, any further compile under
+  sanitize mode raises :class:`SanitizerError` — the "compiles after
+  warmup == 0" serving invariant (README "Online serving") enforced at
+  the moment of violation, with the offending shape in the message,
+  rather than discovered as a latency regression in a dashboard.
+  Warmed state is scoped per cache so services cannot close each
+  other's windows; the module-level counters aggregate process-wide
+  for reporting.
+
+The counters always run (they are two integer bumps); only the
+*raising* behavior is gated on the environment variable, so tests can
+assert on :func:`compile_count` / :func:`post_warmup_compiles` without
+enabling enforcement.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+
+__all__ = [
+    "SanitizerError",
+    "enabled",
+    "note_compile",
+    "warmup_complete",
+    "warmed_up",
+    "compile_count",
+    "post_warmup_compiles",
+    "transfer_guard",
+    "no_recompile",
+    "reset",
+]
+# NOTE: the *warmed* decision for the serving executable cache is
+# scoped per cache and per device (ExecutableCache._warmed_devices,
+# passed via note_compile's post_warmup argument); the globals here
+# are the process-wide counters/flag for reporting and for
+# integrations without their own lifecycle object.
+
+
+class SanitizerError(RuntimeError):
+    """A device-discipline invariant was violated at runtime."""
+
+
+_lock = threading.Lock()
+_compiles = 0
+_post_warmup_compiles = 0
+_warmed = False
+
+
+def enabled() -> bool:
+    """Sanitize mode is on (checked per call so tests can toggle)."""
+    return os.environ.get("PORQUA_SANITIZE") == "1"
+
+
+def reset() -> None:
+    """Zero the counters and re-open the warmup window (test helper)."""
+    global _compiles, _post_warmup_compiles, _warmed
+    with _lock:
+        _compiles = 0
+        _post_warmup_compiles = 0
+        _warmed = False
+
+
+def note_compile(what: str = "",
+                 post_warmup: "bool | None" = None) -> None:
+    """Record one XLA compile *demand*; raise under sanitize mode
+    post-warmup. Demands, not completions: a refused post-warmup
+    compile (this function raising before the compile runs) and a
+    compile that subsequently fails both count — the demand itself is
+    the invariant violation the counters exist to surface.
+
+    ``post_warmup`` lets the caller scope the warmup decision to its
+    own lifecycle — the serving ``ExecutableCache`` passes its
+    per-cache warmed flag, so two services in one process cannot close
+    (or re-open) each other's warmup windows. ``None`` falls back to
+    the process-global flag set by :func:`warmup_complete`.
+    """
+    global _compiles, _post_warmup_compiles
+    with _lock:
+        _compiles += 1
+        post = _warmed if post_warmup is None else bool(post_warmup)
+        if post:
+            _post_warmup_compiles += 1
+    if post and enabled():
+        raise SanitizerError(
+            f"XLA compile after warmup{f' ({what})' if what else ''}: the "
+            "steady-state serving invariant is zero recompiles — prewarm "
+            "the missing shape bucket, or widen the bucket ladder")
+
+
+def warmup_complete() -> None:
+    """Declare warmup over for callers relying on the process-global
+    flag (integrations that own a cache pass ``post_warmup``
+    explicitly instead)."""
+    global _warmed
+    with _lock:
+        _warmed = True
+
+
+def warmed_up() -> bool:
+    with _lock:
+        return _warmed
+
+
+def compile_count() -> int:
+    """Total compile demands recorded (see :func:`note_compile`)."""
+    with _lock:
+        return _compiles
+
+
+def post_warmup_compiles() -> int:
+    """Compile demands recorded after warmup (refusals included)."""
+    with _lock:
+        return _post_warmup_compiles
+
+
+@contextlib.contextmanager
+def transfer_guard(level: str = "disallow"):
+    """``jax.transfer_guard(level)`` when sanitize mode is on, no-op
+    otherwise. Imports jax lazily: the guard is only paid for (and jax
+    only required at this point) when enforcement is actually on."""
+    if not enabled():
+        yield
+        return
+    import jax
+
+    with jax.transfer_guard(level):
+        yield
+
+
+@contextlib.contextmanager
+def no_recompile(what: str = ""):
+    """Assert no compile was demanded inside the block (enforced only
+    under sanitize mode; always measured)."""
+    before = compile_count()
+    yield
+    delta = compile_count() - before
+    if delta and enabled():
+        raise SanitizerError(
+            f"{delta} XLA compile demand(s) inside a no-recompile window"
+            f"{f' ({what})' if what else ''}")

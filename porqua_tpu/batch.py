@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from porqua_tpu.analysis import sanitize
 from porqua_tpu.backtest import Backtest, BacktestService
 from porqua_tpu.portfolio import Portfolio, Strategy
 from porqua_tpu.qp.canonical import CanonicalQP, stack_qps
@@ -131,10 +132,18 @@ def build_problems(bs: BacktestService,
 
 def solve_batch(problems: BatchProblems,
                 params: SolverParams = SolverParams()) -> QPSolution:
-    """Pass 2, independent dates: one vmapped device solve."""
-    return solve_qp_batch(problems.qp, params,
-                          l1_weight=problems.l1_weight,
-                          l1_center=problems.l1_center)
+    """Pass 2, independent dates: one vmapped device solve.
+
+    Under ``PORQUA_SANITIZE=1`` the dispatch runs inside
+    ``jax.transfer_guard("disallow")``: the problems were placed on
+    device by :func:`build_problems` (``stack_qps``), so any implicit
+    host transfer the solve path picks up is a discipline bug and
+    raises instead of silently round-tripping.
+    """
+    with sanitize.transfer_guard():
+        return solve_qp_batch(problems.qp, params,
+                              l1_weight=problems.l1_weight,
+                              l1_center=problems.l1_center)
 
 
 # Sentinel for scan-coupled entry points: the caller attests that every
@@ -375,7 +384,19 @@ def as_requests(problems: BatchProblems) -> List[CanonicalQP]:
     request the micro-batcher re-coalesces with whatever else is in
     flight. Fields are numpy views into the stacked arrays (no copy);
     the serve bucketizer re-pads them to its own shape ladder.
+
+    Batches carrying a native L1 objective term are rejected: the term
+    lives outside the :class:`CanonicalQP` pytree and the serve entry
+    point ``(qp, x0, y0)`` cannot express it — dropping it silently
+    would hand the service a *different* optimization problem per date.
     """
+    if problems.l1_weight is not None or problems.l1_center is not None:
+        raise ValueError(
+            "as_requests cannot bridge a batch with a native L1 "
+            "objective term (l1_weight/l1_center): the serve executable "
+            "signature has no L1 inputs, so the requests would silently "
+            "solve a different problem. Lower the cost term into the "
+            "constraint rows (qp.lift) before bridging.")
     leaves = jax.tree.map(np.asarray, problems.qp)
     return [
         jax.tree.map(lambda a: a[i], leaves)

@@ -33,6 +33,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from porqua_tpu.analysis import sanitize
 from porqua_tpu.qp.admm import Status
 from porqua_tpu.qp.canonical import CanonicalQP, stack_qps
 from porqua_tpu.serve.bucketing import Bucket, ExecutableCache, slot_count
@@ -105,6 +106,7 @@ class WarmStartCache:
     def __init__(self, capacity: int = 4096) -> None:
         self.capacity = int(capacity)
         self._lock = threading.Lock()
+        # guarded-by: self._lock
         self._data: "collections.OrderedDict[tuple, Tuple[np.ndarray, np.ndarray]]" = (
             collections.OrderedDict())
 
@@ -332,13 +334,23 @@ class MicroBatcher:
             try:
                 exe = self.cache.get(bucket, slots, dtype, device)
                 t0 = time.perf_counter()
-                sol = exe(qp, x0, y0)
+                sol = self._call_executable(exe, device, qp, x0, y0)
                 np.asarray(sol.status)  # force completion, honestly timed
                 solve_s = time.perf_counter() - t0
                 self.health.record_success()
                 label = (f"{device.platform}:{device.id}"
                          if device is not None else "default")
                 return sol, label, solve_s
+            except sanitize.SanitizerError as exc:
+                # A sanitizer policy violation (e.g. a post-warmup
+                # compile demand) is not a device fault: fail THIS
+                # batch loudly and leave the circuit breaker alone —
+                # tripping it would degrade every healthy bucket's
+                # traffic to the fallback device over one cold request.
+                for r in live:
+                    self.metrics.inc("failed")
+                    r.future.set_exception(SolveError(f"sanitizer: {exc}"))
+                return None
             except Exception as exc:  # noqa: BLE001 - device faults vary
                 last_exc = exc
                 self.metrics.inc("dispatch_failures")
@@ -349,3 +361,39 @@ class MicroBatcher:
             r.future.set_exception(SolveError(
                 f"dispatch failed on every device: {last_exc!r}"))
         return None
+
+    @staticmethod
+    def _call_executable(exe, device, qp, x0, y0):
+        """Run one compiled dispatch; under ``PORQUA_SANITIZE=1`` the
+        one intentional host->device batch transfer is made explicit
+        (``jax.device_put``) and the dispatch itself runs inside
+        ``jax.transfer_guard("disallow")`` — any *other* transfer the
+        hot path picks up (a stray numpy operand, a hidden
+        device->host fetch) raises instead of silently serializing."""
+        if not sanitize.enabled():
+            return exe(qp, x0, y0)
+        import jax
+
+        args = (qp, x0, y0)
+        args = (jax.device_put(args, device) if device is not None
+                else jax.device_put(args))
+        with sanitize.transfer_guard():
+            try:
+                return exe(*args)
+            except Exception as exc:  # noqa: BLE001 - classify below
+                # A transfer-guard trip surfaces as jax's generic
+                # RuntimeError; reclassify it so _execute's
+                # SanitizerError branch handles it (fail the batch
+                # loudly, breaker stays closed) instead of the
+                # device-fault path counting it toward tripping the
+                # breaker — or a fallback retry silently swallowing
+                # the discipline violation. Matching on the message is
+                # the only hook jax exposes here; if a future jax
+                # rewords it, the violation degrades to the generic
+                # device-fault path (noisier, never silent).
+                msg = str(exc)
+                if "isallow" in msg and "transfer" in msg.lower():
+                    raise sanitize.SanitizerError(
+                        f"implicit transfer inside the dispatch hot "
+                        f"path: {exc}") from exc
+                raise

@@ -28,6 +28,7 @@ from typing import Dict, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
+from porqua_tpu.analysis import sanitize
 from porqua_tpu.qp.canonical import CanonicalQP, pad_qp
 from porqua_tpu.qp.solve import (
     SolverParams,
@@ -131,7 +132,23 @@ class ExecutableCache:
         self.params = params
         self.metrics = metrics
         self._lock = threading.Lock()
-        self._cache: Dict[tuple, object] = {}
+        self._cache: Dict[tuple, object] = {}  # guarded-by: self._lock
+        # Sanitizer warmup state, scoped per cache AND per device: a
+        # device whose ladder prewarmed is sealed — misses on it are
+        # steady-state recompiles (raise under PORQUA_SANITIZE=1) —
+        # while a device never prewarmed (the deliberately-skipped
+        # black-holed primary that later recovers) pays its compiles
+        # lazily, as documented, without hard-failing traffic. Scoped
+        # here, not process-globally, so two services cannot close
+        # each other's windows.
+        self._warmed_devices: set = set()  # guarded-by: self._lock
+        # (bucket, device_key) -> in-flight prewarm depth: their
+        # compiles are warmup even when the device is sealed, WITHOUT
+        # exempting concurrent misses on other buckets or other
+        # devices (a mid-traffic prewarm must not open a wider
+        # enforcement hole), and concurrent same-bucket prewarms each
+        # hold their own exemption (counter, not a flag).
+        self._warming: Dict[tuple, int] = {}  # guarded-by: self._lock
 
     @staticmethod
     def _device_key(device) -> tuple:
@@ -141,6 +158,11 @@ class ExecutableCache:
 
     def get(self, bucket: Bucket, slots: int, dtype, device=None):
         """The compiled executable for one (bucket, batch, device)."""
+        return self._get(bucket, slots, dtype, device)[0]
+
+    def _get(self, bucket: Bucket, slots: int, dtype, device=None):
+        """(executable, missed): ``missed`` lets prewarm count ITS OWN
+        compiles exactly instead of diffing cache sizes across threads."""
         key = (bucket, int(slots), np.dtype(dtype).str,
                self._device_key(device))
         with self._lock:
@@ -148,8 +170,18 @@ class ExecutableCache:
             if exe is not None:
                 if self.metrics is not None:
                     self.metrics.inc("cache_hits")
-                return exe
+                return exe, False
             t0 = time.perf_counter()
+            # Sanitizer hook: every AOT compile is counted; after
+            # prewarm() closes this cache's warmup window, a miss here
+            # raises under PORQUA_SANITIZE=1 (the zero-steady-state-
+            # recompiles invariant) instead of silently paying a
+            # multi-second compile mid-traffic.
+            dev_key = self._device_key(device)
+            sanitize.note_compile(
+                f"bucket={bucket} slots={int(slots)} device={dev_key}",
+                post_warmup=(dev_key in self._warmed_devices
+                             and not self._warming.get((bucket, dev_key))))
             struct = batch_shape_struct(
                 int(slots), bucket.n, bucket.m, dtype=dtype,
                 factor_rows=bucket.factor_rows)
@@ -157,16 +189,42 @@ class ExecutableCache:
             self._cache[key] = exe
             if self.metrics is not None:
                 self.metrics.observe_compile(time.perf_counter() - t0)
-            return exe
+            return exe, True
+
+    @property
+    def warmed(self) -> bool:
+        """At least one device's ladder prewarmed successfully
+        (sanitizer enforcement armed for that device)."""
+        with self._lock:
+            return bool(self._warmed_devices)
 
     def prewarm(self, bucket: Bucket, max_batch: int, dtype,
                 device=None) -> int:
         """Compile the whole slot ladder for one bucket; returns the
-        number of executables compiled (cache misses)."""
-        before = len(self._cache)
-        for s in slot_ladder(max_batch):
-            self.get(bucket, s, dtype, device)
-        return len(self._cache) - before
+        number of executables compiled (cache misses). ``(bucket,
+        device)``'s compiles count as warmup for the duration (so
+        re-prewarming a missing bucket mid-traffic is the sanctioned
+        fix, not itself a violation), while concurrent misses on other
+        buckets or devices stay enforced. The device is sealed only
+        when the whole ladder compiled — a prewarm that died partway
+        must not arm enforcement over a half-warm cache."""
+        compiled = 0
+        key = (bucket, self._device_key(device))
+        with self._lock:
+            self._warming[key] = self._warming.get(key, 0) + 1
+        try:
+            for s in slot_ladder(max_batch):
+                compiled += self._get(bucket, s, dtype, device)[1]
+        finally:
+            with self._lock:
+                depth = self._warming[key] - 1
+                if depth:
+                    self._warming[key] = depth
+                else:
+                    del self._warming[key]
+        with self._lock:
+            self._warmed_devices.add(self._device_key(device))
+        return compiled
 
     def __len__(self) -> int:
         return len(self._cache)

@@ -87,10 +87,10 @@ class DeviceHealth:
         self.recovery_interval_s = float(recovery_interval_s)
         self.metrics = metrics
         self._lock = threading.Lock()
-        self._failures = 0
-        self._degraded = False
-        self._opened_at = 0.0
-        self._recovery_inflight = False
+        self._failures = 0            # guarded-by: self._lock
+        self._degraded = False        # guarded-by: self._lock
+        self._opened_at = 0.0         # guarded-by: self._lock
+        self._recovery_inflight = False  # guarded-by: self._lock
         self._publish()
 
     # -- internals ---------------------------------------------------
@@ -121,7 +121,7 @@ class DeviceHealth:
             self.metrics.inc("probe_failures")
         return ok
 
-    def _trip(self) -> None:
+    def _trip(self) -> None:  # guarded-by: self._lock
         self._degraded = True
         self._opened_at = time.monotonic()
         if self.metrics is not None:
@@ -270,6 +270,11 @@ class SolveService:
         bucket = self.ladder.select(example)
         dtype = np.asarray(example.q).dtype if dtype is None else dtype
         current = self.health.device()
+        # Prewarm is the warmup boundary for the runtime sanitizer:
+        # the executable cache re-opens its own warmup window for the
+        # duration and closes it on exit; once closed, any cache miss
+        # is a steady-state recompile and raises under
+        # PORQUA_SANITIZE=1 (see ExecutableCache.prewarm).
         n = self.cache.prewarm(bucket, self.batcher.max_batch, dtype,
                                current)
         if self.health.fallback is not current:
@@ -279,7 +284,9 @@ class SolveService:
         # prewarm time, only the fallback ladder compiles — AOT
         # compilation against a black-holed primary would hang prewarm
         # for exactly the window the breaker is bridging. A later
-        # recovery therefore pays its primary compiles lazily.
+        # recovery therefore pays its primary compiles lazily, which
+        # the sanitizer permits: sealing is per device, and a device
+        # that never prewarmed is never sealed.
         return n
 
     def submit(self,

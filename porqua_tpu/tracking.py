@@ -149,12 +149,18 @@ def synthetic_universe(key: jax.Array,
     windows with idiosyncratic noise, benchmark = noisy random-weight
     portfolio, daily-return scale.
     """
-    k1, k2, k3, k4 = jax.random.split(key, 4)
+    k1, k2, k3, k4, k5 = jax.random.split(key, 5)
     factors = jax.random.normal(k1, (n_dates, window, n_factors), dtype) * 0.01
     loadings = jax.random.normal(k2, (n_dates, n_factors, n_assets), dtype)
     idio = jax.random.normal(k3, (n_dates, window, n_assets), dtype) * 0.005
-    Xs = jnp.einsum("btf,bfn->btn", factors, loadings) + idio
+    # Pinned like every contraction in this module (GC001): on TPU the
+    # default bf16 passes would perturb the generated benchmark data
+    # itself, not just the solves run on it.
+    Xs = jnp.einsum("btf,bfn->btn", factors, loadings, precision=HP) + idio
     w_true = jax.random.dirichlet(k4, jnp.ones(n_assets), (n_dates,)).astype(dtype)
-    ys = jnp.einsum("btn,bn->bt", Xs, w_true)
-    ys = ys + jax.random.normal(k2, ys.shape, dtype) * 0.001
+    ys = jnp.einsum("btn,bn->bt", Xs, w_true, precision=HP)
+    # Fresh key for the observation noise: reusing the loadings key
+    # would replay the same bit stream, correlating "noise" with the
+    # loadings instead of drawing it independently.
+    ys = ys + jax.random.normal(k5, ys.shape, dtype) * 0.001
     return Xs, ys

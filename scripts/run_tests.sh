@@ -7,6 +7,17 @@
 set -u
 cd "$(dirname "$0")/.."
 fail=0
+
+# graftcheck: the static-analysis + jaxpr-contract gate runs everywhere
+# the tests do (rule docs: README "Static analysis & sanitizers").
+if out=$(timeout 600 python scripts/run_checks.py porqua_tpu 2>&1); then
+    echo "OK   graftcheck: $(echo "$out" | tail -1)"
+else
+    echo "FAIL graftcheck:"
+    echo "$out"
+    fail=1
+fi
+
 for f in tests/test_*.py; do
     for attempt in 1 2; do
         out=$(timeout 1800 python -m pytest "$f" -q --no-header 2>&1)

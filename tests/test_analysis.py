@@ -1,0 +1,484 @@
+"""graftcheck (porqua_tpu.analysis): the AST rules, the guarded-by
+lint, the jaxpr contracts, and the runtime sanitizer.
+
+Two kinds of coverage: (1) seeded violations — one fixture per rule —
+must each be detected with the right rule id and line number; (2) the
+shipped ``porqua_tpu/`` tree must scan clean (the self-scan is the
+regression gate that keeps the device-discipline invariants holding as
+the codebase grows).
+"""
+
+import os
+import textwrap
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import porqua_tpu
+from porqua_tpu.analysis import sanitize
+from porqua_tpu.analysis.lint import scan_paths
+from porqua_tpu.serve import BucketLadder, SolveError, SolveService
+
+REPO_PKG = os.path.dirname(os.path.abspath(porqua_tpu.__file__))
+
+
+def write_fixture(tmp_path, relpath, source):
+    path = tmp_path / relpath
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    return str(path)
+
+
+def findings_for(tmp_path, relpath, source, rules=None):
+    path = write_fixture(tmp_path, relpath, source)
+    return [(f.rule, f.line) for f in scan_paths([path], rules=rules)]
+
+
+# ---------------------------------------------------------------------------
+# GC001 — precision pins
+# ---------------------------------------------------------------------------
+
+def test_gc001_unpinned_contraction_detected(tmp_path):
+    hits = findings_for(tmp_path, "qp/bad.py", """\
+        import jax.numpy as jnp
+
+        def f(a, b):
+            return jnp.dot(a, b)
+
+        def pinned(a, b):
+            return jnp.dot(a, b, precision="highest")
+        """)
+    assert hits == [("GC001", 4)]
+
+
+def test_gc001_matmul_operator_on_jnp_operand(tmp_path):
+    hits = findings_for(tmp_path, "qp/bad.py", """\
+        import jax.numpy as jnp
+        import numpy as np
+
+        def f(a):
+            c = jnp.eye(3)
+            return c @ a
+
+        def host_only(a):
+            P = np.eye(3)
+            return P @ a
+        """)
+    assert hits == [("GC001", 6)]  # numpy @ stays exempt
+
+
+def test_gc001_matmul_on_params_of_jitted_fn(tmp_path):
+    hits = findings_for(tmp_path, "qp/mod.py", """\
+        import jax
+
+        @jax.jit
+        def f(x, P):
+            return x @ P
+
+        def host(x, P):
+            return x @ P
+        """, rules={"GC001"})
+    assert hits == [("GC001", 5)]  # params are traced inside jit
+
+
+def test_gc001_scoped_to_precision_modules(tmp_path):
+    hits = findings_for(tmp_path, "models/fine.py", """\
+        import jax.numpy as jnp
+
+        def f(a, b):
+            return jnp.dot(a, b)
+        """)
+    assert hits == []
+
+
+def test_gc001_line_suppression(tmp_path):
+    hits = findings_for(tmp_path, "qp/bad.py", """\
+        import jax.numpy as jnp
+
+        def f(a, b):
+            return jnp.dot(a, b)  # graftcheck: disable=GC001
+        """)
+    assert hits == []
+
+
+# ---------------------------------------------------------------------------
+# GC002 — host syncs in jit-reachable code
+# ---------------------------------------------------------------------------
+
+def test_gc002_hazards_reachable_through_call_graph(tmp_path):
+    hits = findings_for(tmp_path, "mod.py", """\
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def hot(x):
+            return helper(x)
+
+        def helper(x):
+            np.asarray(x)
+            return x.item()
+
+        def host_side(x):
+            return float(np.asarray(x).sum())
+        """)
+    assert ("GC002", 9) in hits   # np.asarray in reachable helper
+    assert ("GC002", 10) in hits  # .item() in reachable helper
+    assert not any(line == 13 for _, line in hits)  # host code exempt
+
+
+def test_gc002_scan_body_is_a_root(tmp_path):
+    hits = findings_for(tmp_path, "mod.py", """\
+        import jax
+
+        def run(xs):
+            def body(c, x):
+                return c + x.item(), None
+            return jax.lax.scan(body, 0.0, xs)
+        """)
+    assert hits == [("GC002", 5)]
+
+
+def test_gc002_from_import_jit_roots(tmp_path):
+    hits = findings_for(tmp_path, "mod.py", """\
+        from jax import jit
+        from jax.lax import scan
+
+        @jit
+        def hot(x):
+            return x.item()
+
+        def run(xs):
+            def body(c, x):
+                return c + float(x), None
+            return scan(body, 0.0, xs)
+        """, rules={"GC002"})
+    assert ("GC002", 6) in hits   # @jit via from-import
+    assert ("GC002", 10) in hits  # scan body via from-import
+
+
+# ---------------------------------------------------------------------------
+# GC003 — recompile hazards
+# ---------------------------------------------------------------------------
+
+def test_gc003_jit_in_loop_and_in_function(tmp_path):
+    hits = findings_for(tmp_path, "serve/mod.py", """\
+        import jax
+
+        def looped(fs, x):
+            for f in fs:
+                x = jax.jit(f)(x)
+            return x
+
+        def local(f, x):
+            return jax.jit(f)(x)
+
+        def aot(f, x):
+            return jax.jit(f).lower(x).compile()
+
+        class Holder:
+            def prime(self, f):
+                self._fn = jax.jit(f)
+        """)
+    assert ("GC003", 5) in hits
+    assert ("GC003", 9) in hits
+    assert not any(line in (12, 16) for _, line in hits)  # exemptions
+
+
+def test_gc003_unhashable_static_default(tmp_path):
+    hits = findings_for(tmp_path, "qp/mod.py", """\
+        import functools
+
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("opts",))
+        def f(x, opts=[1, 2]):
+            return x
+        """)
+    assert ("GC003", 6) in hits
+
+
+# ---------------------------------------------------------------------------
+# GC004 / GC005
+# ---------------------------------------------------------------------------
+
+def test_gc004_debug_hooks(tmp_path):
+    hits = findings_for(tmp_path, "mod.py", """\
+        import jax
+
+        def f(x):
+            jax.debug.print("x={}", x)
+            breakpoint()
+            return x
+        """)
+    assert ("GC004", 4) in hits and ("GC004", 5) in hits
+
+
+def test_gc005_module_level_backend_init(tmp_path):
+    hits = findings_for(tmp_path, "mod.py", """\
+        import jax
+        import jax.numpy as jnp
+
+        EAGER = jnp.zeros(3)
+        JITTED = jax.jit(lambda x: x)  # lazy: fine
+
+        def lazy():
+            return jnp.zeros(3)
+        """, rules={"GC005"})
+    assert hits == [("GC005", 4)]
+
+
+def test_gc005_ignores_defs_nested_in_module_level_blocks(tmp_path):
+    hits = findings_for(tmp_path, "mod.py", """\
+        import jax.numpy as jnp
+
+        try:
+            import scipy  # noqa: F401
+        except ImportError:
+            def fallback():
+                return jnp.zeros(3)
+
+        def g(x=jnp.zeros(3)):  # default DOES run at import
+            return x
+        """, rules={"GC005"})
+    assert hits == [("GC005", 9)]
+
+
+def test_file_suppression(tmp_path):
+    hits = findings_for(tmp_path, "mod.py", """\
+        # graftcheck: disable-file=GC005
+        import jax.numpy as jnp
+
+        EAGER = jnp.zeros(3)
+        """, rules={"GC005"})
+    assert hits == []
+
+
+# ---------------------------------------------------------------------------
+# GC006 — guarded-by
+# ---------------------------------------------------------------------------
+
+def test_gc006_guarded_by(tmp_path):
+    hits = findings_for(tmp_path, "serve/locks.py", """\
+        import threading
+
+        class Cache:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._data = {}  # guarded-by: self._lock
+
+            def good(self, k, v):
+                with self._lock:
+                    self._data[k] = v
+
+            def nested_ok(self, k, v):
+                if k:
+                    with self._lock:
+                        self._data.update({k: v})
+
+            def bad_assign(self, k, v):
+                self._data[k] = v
+
+            def bad_method(self, k):
+                self._data.pop(k)
+
+            def held(self, k):  # guarded-by: self._lock
+                del self._data[k]
+        """)
+    assert hits == [("GC006", 18), ("GC006", 21)]
+
+
+def test_gc006_nested_def_does_not_inherit_lock(tmp_path):
+    hits = findings_for(tmp_path, "serve/locks.py", """\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0  # guarded-by: self._lock
+
+            def spawn(self):
+                with self._lock:
+                    def worker():
+                        self._n += 1
+                    return worker
+        """)
+    assert hits == [("GC006", 11)]
+
+
+# ---------------------------------------------------------------------------
+# self-scan: the shipped tree is clean
+# ---------------------------------------------------------------------------
+
+def test_self_scan_shipped_tree_is_clean():
+    findings = scan_paths([REPO_PKG])
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# jaxpr contracts
+# ---------------------------------------------------------------------------
+
+def test_contracts_entry_points_clean():
+    from porqua_tpu.analysis import contracts
+
+    findings = contracts.check_entry_points()
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_contracts_run_batch_clean(rng):
+    from porqua_tpu import (
+        BacktestService,
+        LeastSquares,
+        OptimizationItemBuilder,
+        SelectionItemBuilder,
+    )
+    from porqua_tpu.analysis import contracts
+    from porqua_tpu.builders import (
+        bibfn_bm_series,
+        bibfn_box_constraints,
+        bibfn_budget_constraint,
+        bibfn_return_series,
+        bibfn_selection_data,
+    )
+
+    n_assets, n_days = 6, 140
+    dates = pd.bdate_range("2021-01-01", periods=n_days)
+    X = pd.DataFrame(rng.standard_normal((n_days, n_assets)) * 0.01,
+                     index=dates,
+                     columns=[f"A{i}" for i in range(n_assets)])
+    w = rng.dirichlet(np.ones(n_assets))
+    y = pd.DataFrame(
+        {"bm": X.to_numpy() @ w + rng.standard_normal(n_days) * 0.001},
+        index=dates)
+    rebdates = [str(d.date()) for d in dates[80::20][:3]]
+    bs = BacktestService(
+        data={"return_series": X, "bm_series": y},
+        selection_item_builders={
+            "data": SelectionItemBuilder(bibfn=bibfn_selection_data)},
+        optimization_item_builders={
+            "returns": OptimizationItemBuilder(bibfn=bibfn_return_series,
+                                               width=60),
+            "bm": OptimizationItemBuilder(bibfn=bibfn_bm_series, width=60,
+                                          align=True),
+            "budget": OptimizationItemBuilder(bibfn=bibfn_budget_constraint),
+            "box": OptimizationItemBuilder(bibfn=bibfn_box_constraints),
+        },
+        optimization=LeastSquares(),
+        settings={"rebdates": rebdates, "quiet": True},
+    )
+    findings = contracts.check_run_batch(bs)
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_contracts_detect_seeded_violations():
+    from porqua_tpu.analysis import contracts
+
+    def bad(x):
+        y = x.astype(jnp.float64)
+
+        def cb(a):
+            return np.asarray(a)
+
+        z = jax.pure_callback(
+            cb, jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+        return y, z, jnp.arange(4)
+
+    closed = jax.make_jaxpr(bad)(jax.ShapeDtypeStruct((4,), jnp.float32))
+    rules = {f.rule for f in contracts.check_closed_jaxpr(closed, "bad")}
+    # f64 cast, callback primitive, and the f64/int64 outputs
+    assert {"GC101", "GC102", "GC103"} <= rules
+
+
+# ---------------------------------------------------------------------------
+# runtime sanitizer
+# ---------------------------------------------------------------------------
+
+SERVE_PARAMS = porqua_tpu.SolverParams(
+    max_iter=300, eps_abs=1e-4, eps_rel=1e-4, polish=False,
+    check_interval=25)
+
+
+def make_qp(n=6, m=2, seed=0):
+    rng = np.random.default_rng(seed)
+    A = rng.standard_normal((2 * n, n))
+    P = A.T @ A / (2 * n) + np.eye(n)
+    q = rng.standard_normal(n)
+    C = np.concatenate([np.ones((1, n)), rng.standard_normal((m - 1, n))])
+    return porqua_tpu.CanonicalQP.build(
+        P, q, C=C, l=np.full(m, -1.0), u=np.ones(m),
+        lb=np.zeros(n), ub=np.ones(n))
+
+
+def test_sanitizer_transfer_guard(monkeypatch):
+    monkeypatch.delenv("PORQUA_SANITIZE", raising=False)
+    with sanitize.transfer_guard():  # disabled: a no-op
+        jnp.sin(np.ones(3)).block_until_ready()
+
+    monkeypatch.setenv("PORQUA_SANITIZE", "1")
+    with pytest.raises(Exception, match="[Dd]isallow"):
+        with sanitize.transfer_guard():
+            jnp.sin(np.ones(3)).block_until_ready()  # implicit h2d
+    with sanitize.transfer_guard():  # explicit device_put is allowed
+        jnp.sin(jax.device_put(np.ones(3))).block_until_ready()
+
+
+def test_sanitizer_zero_recompiles_after_warmup(monkeypatch):
+    monkeypatch.setenv("PORQUA_SANITIZE", "1")
+    sanitize.reset()
+    try:
+        ladder = BucketLadder(n_rungs=(8, 16), m_rungs=(4,))
+        svc = SolveService(params=SERVE_PARAMS, ladder=ladder,
+                           max_batch=2, max_wait_ms=1.0)
+        with svc:
+            compiled = svc.prewarm(make_qp())
+            assert compiled >= 1
+            assert svc.cache.warmed  # warmup scoped to THIS cache
+            assert sanitize.compile_count() >= compiled
+
+            # Steady state: a prewarmed-bucket solve must not compile.
+            res = svc.solve(make_qp(seed=1), timeout=120)
+            assert res.found
+            assert sanitize.post_warmup_compiles() == 0
+
+            # A cold bucket post-warmup is an invariant violation: the
+            # sanitizer refuses the compile and the request fails loudly
+            # instead of paying a mid-traffic compile stall.
+            with pytest.raises(SolveError, match="compile after warmup"):
+                svc.solve(make_qp(n=12, seed=2), timeout=120)
+            assert sanitize.post_warmup_compiles() >= 1
+            # ...but a policy violation is NOT a device fault: the
+            # circuit breaker stays closed and healthy buckets keep
+            # dispatching on the primary device.
+            assert not svc.health.degraded
+            assert svc.solve(make_qp(seed=3), timeout=120).found
+
+            # A second service's own warmup is unaffected by the
+            # first one having sealed its cache.
+            svc2 = SolveService(params=SERVE_PARAMS,
+                                ladder=BucketLadder(n_rungs=(8,),
+                                                    m_rungs=(4,)),
+                                max_batch=1, max_wait_ms=1.0)
+            with svc2:
+                assert svc2.prewarm(make_qp()) >= 1
+                assert svc2.solve(make_qp(seed=4), timeout=120).found
+    finally:
+        sanitize.reset()
+
+
+def test_sanitizer_counters_run_without_enforcement(monkeypatch):
+    monkeypatch.delenv("PORQUA_SANITIZE", raising=False)
+    sanitize.reset()
+    try:
+        sanitize.note_compile("probe")
+        assert sanitize.compile_count() == 1
+        sanitize.warmup_complete()
+        sanitize.note_compile("probe")  # counted, not raised
+        assert sanitize.post_warmup_compiles() == 1
+        with sanitize.no_recompile():
+            pass  # no compile: fine either way
+    finally:
+        sanitize.reset()
