@@ -706,8 +706,12 @@ def _secondary_config_serving(child_left, n_requests=1024, n_assets=24,
         f"max_batch={max_batch})...")
     requests = build_tracking_requests(n_requests, n_assets=n_assets,
                                        window=WINDOW)
+    # --trace-out (parent argv -> env -> this child): record per-request
+    # spans and write the Perfetto-loadable Chrome trace next to the
+    # JSON artifact; span coverage figures join the payload.
+    trace_out = os.environ.get("PORQUA_BENCH_TRACE_OUT") or None
     report = run_loadgen(requests, max_batch=max_batch,
-                         inflight=4 * max_batch)
+                         inflight=4 * max_batch, trace_out=trace_out)
     _emit({
         "part": "config_serving",
         "n_requests": n_requests,
@@ -725,6 +729,9 @@ def _secondary_config_serving(child_left, n_requests=1024, n_assets=24,
         "errors": report["errors"],
         "degraded": report["degraded"],
         "serve_device": report["device"],
+        **({"trace_out": report.get("trace_out"),
+            "span_cover_median": report.get("span_cover_median")}
+           if trace_out else {}),
         "note": "closed-loop serve_loadgen stream through "
                 "porqua_tpu.serve.SolveService (dynamic micro-batching "
                 "+ AOT executable cache); recompiles_after_warmup==0 "
@@ -1125,6 +1132,19 @@ def _assemble(state) -> dict:
 
 
 def main():
+    # --trace-out PATH: have the serving config record request spans
+    # and write a Perfetto-loadable Chrome trace there. Threaded via
+    # the environment because the serving config runs inside the
+    # device child (spawned with the parent's env) — the flag works on
+    # the parent invocation and on a directly-run child alike.
+    if "--trace-out" in sys.argv:
+        i = sys.argv.index("--trace-out")
+        if i + 1 >= len(sys.argv):
+            print("bench.py: --trace-out requires a path", file=sys.stderr)
+            sys.exit(2)
+        os.environ["PORQUA_BENCH_TRACE_OUT"] = os.path.abspath(
+            sys.argv[i + 1])
+        del sys.argv[i:i + 2]
     if len(sys.argv) >= 3 and sys.argv[1] == "--device-child":
         device_child(sys.argv[2], int(sys.argv[3])
                      if len(sys.argv) > 3 else N_DATES)

@@ -56,6 +56,7 @@ from porqua_tpu.batch import (
     solve_scan_turnover,
 )
 from porqua_tpu.compare import compare_solvers, available_backends
+from porqua_tpu.obs import Observability
 from porqua_tpu.serve import SolveService
 
 __all__ = [
@@ -99,5 +100,6 @@ __all__ = [
     "solve_scan_turnover",
     "compare_solvers",
     "available_backends",
+    "Observability",
     "SolveService",
 ]

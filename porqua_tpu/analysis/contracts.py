@@ -203,8 +203,19 @@ def check_run_batch(bs, params=None, dtype=np.float32) -> List[Finding]:
 
 
 def check_entry_points(dtype=np.float32,
-                       factor_rows: int = 8) -> List[Finding]:
-    """The CI sweep: every entry point reachable without market data."""
+                       factor_rows: int = 8,
+                       ring_size: int = 8) -> List[Finding]:
+    """The CI sweep: every entry point reachable without market data.
+
+    Each batch entry is traced twice — default params AND with the
+    convergence rings enabled (``SolverParams(ring_size=...)``) — so
+    the telemetry-enabled program carries the same proofs as the
+    default one: no host callbacks/transfers (GC102 — the rings are
+    recorded with zero host syncs, and this is where that claim is
+    machine-checked), no f64 leaks, stable output dtypes.
+    """
+    from porqua_tpu.qp.solve import SolverParams
+
     findings: List[Finding] = []
     findings += check_closed_jaxpr(
         solve_batch_jaxpr(dtype=dtype), "solve_batch", expect_float=dtype)
@@ -218,4 +229,12 @@ def check_entry_points(dtype=np.float32,
         "serve_entry[factored]", expect_float=dtype)
     findings += check_closed_jaxpr(
         tracking_jaxpr(dtype=dtype), "tracking_step", expect_float=dtype)
+    if ring_size:
+        ring_params = SolverParams(ring_size=ring_size)
+        findings += check_closed_jaxpr(
+            solve_batch_jaxpr(params=ring_params, dtype=dtype),
+            "solve_batch[rings]", expect_float=dtype)
+        findings += check_closed_jaxpr(
+            serve_entry_jaxpr(params=ring_params, dtype=dtype),
+            "serve_entry[rings]", expect_float=dtype)
     return findings

@@ -35,21 +35,31 @@ _SOLUTION_FIELDS = list(QPSolution._fields)
 
 
 def save_solution(path: str, sol: QPSolution) -> None:
-    """Serialize a (possibly batched) QPSolution to compressed npz."""
-    arrays = {f: np.asarray(getattr(sol, f)) for f in _SOLUTION_FIELDS}
+    """Serialize a (possibly batched) QPSolution to compressed npz.
+    Optional telemetry leaves (the convergence rings, None unless the
+    solve ran with ``ring_size>0``) are simply omitted when absent."""
+    arrays = {f: np.asarray(getattr(sol, f)) for f in _SOLUTION_FIELDS
+              if getattr(sol, f) is not None}
     np.savez_compressed(path, **arrays)
 
 
 def load_solution(path: str) -> QPSolution:
     with np.load(path) as data:
-        return QPSolution(**{f: jnp.asarray(data[f]) for f in _SOLUTION_FIELDS})
+        return QPSolution(**{f: jnp.asarray(data[f])
+                             for f in _SOLUTION_FIELDS if f in data})
 
 
 def _concat_solutions(sols: List[QPSolution]) -> QPSolution:
-    return QPSolution(*[
-        jnp.concatenate([jnp.atleast_1d(getattr(s, f)) for s in sols], axis=0)
-        for f in _SOLUTION_FIELDS
-    ])
+    def cat(f):
+        leaves = [getattr(s, f) for s in sols]
+        if any(v is None for v in leaves):
+            # Optional leaves concatenate only when every chunk has
+            # them (params_key pins ring_size per run, so a mix means
+            # corrupted state — drop rather than invent data).
+            return None
+        return jnp.concatenate([jnp.atleast_1d(v) for v in leaves], axis=0)
+
+    return QPSolution(*[cat(f) for f in _SOLUTION_FIELDS])
 
 
 @dataclasses.dataclass

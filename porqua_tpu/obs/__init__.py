@@ -1,0 +1,64 @@
+"""Unified observability for the online serve stack.
+
+Three pillars, one package (README "Observability" has the schemas):
+
+* **Request span tracing** (:mod:`porqua_tpu.obs.trace`) — trace/span
+  ids minted at ``SolveService.submit`` and recorded at every pipeline
+  transition (pad → queue wait → batch assembly → device dispatch →
+  resolve), exported as Chrome-trace-event JSON that Perfetto loads
+  next to ``jax.profiler`` device traces.
+* **On-device convergence rings** (:mod:`porqua_tpu.obs.rings`, data
+  produced by ``qp/admm.py`` under ``SolverParams(ring_size=K)``) —
+  per-problem ``(prim_res, dual_res, rho)`` sampled at each residual
+  check *inside* the jitted program, zero host syncs; this module
+  decodes them chronologically.
+* **Event log + exposition** (:mod:`porqua_tpu.obs.events`,
+  :mod:`porqua_tpu.obs.exposition`) — a structured JSON-lines event
+  bus (compiles, circuit-breaker transitions, sanitizer violations,
+  backpressure rejections, deadline expiries; severity + trace id),
+  Prometheus text exposition of ``ServeMetrics``, and an optional
+  stdlib-HTTP ``/metrics`` + ``/healthz`` endpoint.
+
+:class:`Observability` bundles one span recorder and one event bus;
+pass it to ``SolveService(obs=...)`` and every layer (batcher,
+executable cache, device health) records through it. The package is
+pure host code — importing it initializes no JAX backend, and nothing
+in it runs on the request hot path beyond lock-bounded appends.
+"""
+
+from porqua_tpu.obs.events import EventBus, load_jsonl
+from porqua_tpu.obs.exposition import ObsHTTPServer, prometheus_text
+from porqua_tpu.obs.report import render_report
+from porqua_tpu.obs.rings import ring_history, solution_ring_history
+from porqua_tpu.obs.trace import Span, SpanRecorder
+
+
+class Observability:
+    """One span recorder + one event bus, shared by a serve stack."""
+
+    def __init__(self, span_capacity: int = 262144,
+                 event_capacity: int = 65536,
+                 event_path=None) -> None:
+        self.spans = SpanRecorder(capacity=span_capacity)
+        self.events = EventBus(capacity=event_capacity, path=event_path)
+
+    def write(self, trace_path=None, events_path=None) -> None:
+        """Dump whichever artifacts were requested."""
+        if trace_path:
+            self.spans.write(trace_path)
+        if events_path:
+            self.events.write_jsonl(events_path)
+
+
+__all__ = [
+    "EventBus",
+    "Observability",
+    "ObsHTTPServer",
+    "Span",
+    "SpanRecorder",
+    "load_jsonl",
+    "prometheus_text",
+    "render_report",
+    "ring_history",
+    "solution_ring_history",
+]

@@ -1,0 +1,117 @@
+"""Structured JSON-lines event bus for the serve stack.
+
+Metrics answer "how much"; the event bus answers "what happened, when,
+to which request": compile events from the executable cache,
+circuit-breaker transitions from the device-health manager, sanitizer
+violations, backpressure rejections, and deadline expiries are each
+one structured record stamped with a severity and (where one exists)
+the request's trace id, so a latency outlier in the span timeline
+cross-references to the compile or breaker flip that caused it.
+
+Events are plain dicts — host-side, lock-protected, bounded (a serving
+process must not grow its event buffer without limit), with an
+optional streaming JSON-lines sink so a crash loses at most the last
+buffered line. Event schema: README "Observability".
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+#: Severity order, least to most severe.
+SEVERITIES = ("debug", "info", "warn", "error")
+
+
+class EventBus:
+    """Thread-safe bounded event sink with optional JSONL streaming.
+
+    ``emit`` never raises and never blocks on anything but the lock —
+    it is called from hot serving paths (dispatch thread, submitter
+    threads), so a broken sink file degrades to counting drops rather
+    than failing a batch. The buffer is a ring keeping the NEWEST
+    ``capacity`` events (evictions are counted in ``dropped``): the
+    recent tail — the breaker flip that just happened — is what a
+    diagnostic read needs; use the streaming ``path`` sink to keep the
+    complete history.
+    """
+
+    def __init__(self, capacity: int = 65536,
+                 path: Optional[str] = None) -> None:
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        # guarded-by: self._lock
+        self._events: "collections.deque[Dict[str, Any]]" = (
+            collections.deque(maxlen=self.capacity))
+        self._dropped = 0                        # guarded-by: self._lock
+        self._sink = open(path, "a") if path else None
+
+    def emit(self, kind: str, severity: str = "info",
+             trace_id: Optional[str] = None, **fields) -> Dict[str, Any]:
+        """Record one event; returns the record (for tests/logging)."""
+        if severity not in SEVERITIES:
+            severity = "info"
+        event: Dict[str, Any] = {
+            "t": time.time(),
+            "kind": kind,
+            "severity": severity,
+        }
+        if trace_id is not None:
+            event["trace_id"] = trace_id
+        event.update(fields)
+        with self._lock:
+            if len(self._events) == self.capacity:
+                self._dropped += 1  # deque evicts the oldest
+            self._events.append(event)
+            if self._sink is not None:
+                try:
+                    self._sink.write(json.dumps(event, default=str) + "\n")
+                    self._sink.flush()
+                except OSError:
+                    self._sink = None  # dead sink: keep serving
+        return event
+
+    # -- readers -----------------------------------------------------
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    def events(self, kind: Optional[str] = None,
+               min_severity: str = "debug") -> List[Dict[str, Any]]:
+        """Buffered events, optionally filtered by kind and severity."""
+        floor = SEVERITIES.index(min_severity)
+        with self._lock:
+            return [e for e in self._events
+                    if (kind is None or e["kind"] == kind)
+                    and SEVERITIES.index(e["severity"]) >= floor]
+
+    def write_jsonl(self, path: str) -> int:
+        """Dump every buffered event to ``path``; returns the count."""
+        events = self.events()
+        with open(path, "w") as f:
+            for e in events:
+                f.write(json.dumps(e, default=str) + "\n")
+        return len(events)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sink is not None:
+                self._sink.close()
+                self._sink = None
+
+
+def load_jsonl(path: str) -> List[Dict[str, Any]]:
+    """Read an event JSONL file back into a list of dicts (blank lines
+    skipped) — the reader ``scripts/obs_report.py`` uses."""
+    out: List[Dict[str, Any]] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out

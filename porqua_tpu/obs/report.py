@@ -1,0 +1,230 @@
+"""Render one serving run as text: waterfall, latency, sparklines.
+
+The reader side of the observability pillar — consumes the three
+artifacts a traced run emits (the Chrome-trace span file, the event
+JSONL, the metrics snapshot) and renders what an operator actually
+asks: *where did the time go* (stage waterfall aggregated over every
+request span), *what did callers see* (latency/throughput table), and
+*what did the solver do on device* (convergence sparklines from ring
+events). ``scripts/obs_report.py`` is the CLI. Pure host/stdlib+numpy:
+rendering a report must never initialize a JAX backend.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+#: Canonical request-span order, for waterfall sorting.
+STAGE_ORDER = ("submit", "queue_wait", "assemble", "solve", "resolve")
+
+_SPARK_GLYPHS = "▁▂▃▄▅▆▇█"
+
+
+def _trace_events(trace: Any) -> List[Dict[str, Any]]:
+    """Accept either the Chrome trace object or its event list."""
+    if isinstance(trace, dict):
+        return list(trace.get("traceEvents", []))
+    return list(trace or [])
+
+
+def span_aggregate(trace: Any) -> Dict[str, Dict[str, float]]:
+    """Per-stage rollup over every ``"X"`` event: count, total/mean/max
+    milliseconds."""
+    agg: Dict[str, List[float]] = {}
+    for e in _trace_events(trace):
+        if e.get("ph") != "X":
+            continue
+        agg.setdefault(e["name"], []).append(float(e.get("dur", 0.0)))
+    out: Dict[str, Dict[str, float]] = {}
+    for name, durs in agg.items():
+        a = np.asarray(durs)
+        out[name] = {
+            "count": float(a.size),
+            "total_ms": float(a.sum()) * 1e-3,
+            "mean_ms": float(a.mean()) * 1e-3,
+            "p99_ms": float(np.percentile(a, 99)) * 1e-3,
+            "max_ms": float(a.max()) * 1e-3,
+        }
+    return out
+
+
+def span_coverage(trace: Any) -> List[Tuple[str, float, float]]:
+    """Per-request ``(trace_id, spans_sum_s, extent_s)``.
+
+    ``spans_sum_s`` adds every span duration carrying the trace id;
+    ``extent_s`` is last-span-end minus first-span-start — the
+    request's observed wall-clock. A well-instrumented pipeline has the
+    two within a few percent (the acceptance bar: 10%); a gap means a
+    stage is living outside any span.
+    """
+    per: Dict[str, List[Tuple[float, float]]] = {}
+    for e in _trace_events(trace):
+        if e.get("ph") != "X":
+            continue
+        tid = (e.get("args") or {}).get("trace_id")
+        if tid is None:
+            continue
+        ts, dur = float(e.get("ts", 0.0)), float(e.get("dur", 0.0))
+        per.setdefault(tid, []).append((ts, dur))
+    out = []
+    for tid, spans in per.items():
+        total = sum(d for _, d in spans) * 1e-6
+        extent = (max(ts + d for ts, d in spans)
+                  - min(ts for ts, _ in spans)) * 1e-6
+        out.append((tid, total, extent))
+    return out
+
+
+def coverage_stats(trace: Any) -> Dict[str, float]:
+    """Summary of :func:`span_coverage`: median/min cover ratio."""
+    cov = span_coverage(trace)
+    if not cov:
+        return {"n_traces": 0, "cover_median": 0.0, "cover_min": 0.0}
+    ratios = sorted(t / e if e > 0 else 1.0 for _, t, e in cov)
+    return {
+        "n_traces": len(ratios),
+        "cover_median": ratios[len(ratios) // 2],
+        "cover_min": ratios[0],
+    }
+
+
+def sparkline(values: Sequence[float], width: int = 40,
+              log: bool = False) -> str:
+    """A one-line unicode sparkline (``log=True`` for residual decay —
+    linear scale renders a 1e6-range trajectory as one step)."""
+    vals = [float(v) for v in values][-width:]
+    if not vals:
+        return ""
+    if log:
+        floor = 1e-300
+        vals = [math.log10(max(abs(v), floor)) for v in vals]
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    if span <= 0:
+        return _SPARK_GLYPHS[0] * len(vals)
+    return "".join(
+        _SPARK_GLYPHS[min(int((v - lo) / span * len(_SPARK_GLYPHS)),
+                          len(_SPARK_GLYPHS) - 1)]
+        for v in vals)
+
+
+# ---------------------------------------------------------------------------
+# sections
+# ---------------------------------------------------------------------------
+
+def waterfall_section(trace: Any) -> str:
+    agg = span_aggregate(trace)
+    if not agg:
+        return "stage waterfall: (no spans)"
+    order = {name: i for i, name in enumerate(STAGE_ORDER)}
+    names = sorted(agg, key=lambda n: (order.get(n, len(order)), n))
+    width = max(len(n) for n in names)
+    total = sum(agg[n]["total_ms"] for n in names)
+    lines = ["stage waterfall (all requests)",
+             f"{'stage':<{width}}  {'count':>7} {'total ms':>10} "
+             f"{'mean ms':>9} {'p99 ms':>9}  share"]
+    for n in names:
+        a = agg[n]
+        share = a["total_ms"] / total if total else 0.0
+        bar = "#" * max(int(share * 30), 1 if a["total_ms"] else 0)
+        lines.append(
+            f"{n:<{width}}  {int(a['count']):>7} {a['total_ms']:>10.1f} "
+            f"{a['mean_ms']:>9.3f} {a['p99_ms']:>9.3f}  {bar}")
+    cov = coverage_stats(trace)
+    if cov["n_traces"]:
+        lines.append(
+            f"span coverage: {cov['n_traces']} traces, median "
+            f"{cov['cover_median']:.2f}x of request wall-clock "
+            f"(min {cov['cover_min']:.2f}x)")
+    return "\n".join(lines)
+
+
+def latency_section(snapshot: Dict[str, Any]) -> str:
+    rows = [
+        ("completed", snapshot.get("completed", 0)),
+        ("failed", snapshot.get("failed", 0)),
+        ("expired", snapshot.get("expired", 0)),
+        ("rejected", snapshot.get("rejected", 0)),
+        ("throughput solves/s",
+         round(float(snapshot.get("throughput_solves_per_s", 0.0)), 1)),
+        ("latency p50 ms",
+         round(float(snapshot.get("latency_p50_ms", 0.0)), 3)),
+        ("latency p90 ms",
+         round(float(snapshot.get("latency_p90_ms", 0.0)), 3)),
+        ("latency p99 ms",
+         round(float(snapshot.get("latency_p99_ms", 0.0)), 3)),
+        ("occupancy mean",
+         round(float(snapshot.get("occupancy_mean", 0.0)), 4)),
+        ("queue wait s",
+         round(float(snapshot.get("queue_wait_seconds", 0.0)), 3)),
+        ("solve s", round(float(snapshot.get("solve_seconds", 0.0)), 3)),
+        ("recompiles", snapshot.get("compiles", 0)),
+        ("device", snapshot.get("device")),
+        ("degraded", snapshot.get("degraded", False)),
+    ]
+    width = max(len(k) for k, _ in rows)
+    return "\n".join(["latency / throughput"]
+                     + [f"{k:<{width}}  {v}" for k, v in rows])
+
+
+def convergence_section(events: Sequence[Dict[str, Any]],
+                        max_rings: int = 8) -> str:
+    """Sparklines from ``convergence_ring`` events (the decoded ring
+    payloads the load generator emits for a sample of requests)."""
+    rings = [e for e in events if e.get("kind") == "convergence_ring"]
+    if not rings:
+        return "convergence: (no ring events)"
+    lines = ["convergence rings (log10 residual sparklines)"]
+    for e in rings[:max_rings]:
+        label = e.get("trace_id") or e.get("request", "?")
+        iters = e.get("iters_final", (e.get("iters") or [0])[-1])
+        prim = e.get("prim_res", [])
+        dual = e.get("dual_res", [])
+        final_p = prim[-1] if prim else float("nan")
+        final_d = dual[-1] if dual else float("nan")
+        lines.append(f"  {label}: {iters} iters, "
+                     f"final prim {final_p:.2e} dual {final_d:.2e}")
+        lines.append(f"    prim {sparkline(prim, log=True)}")
+        lines.append(f"    dual {sparkline(dual, log=True)}")
+    return "\n".join(lines)
+
+
+def events_section(events: Sequence[Dict[str, Any]],
+                   max_shown: int = 12) -> str:
+    """Severity rollup + the most recent warn/error lines."""
+    by_kind: Dict[Tuple[str, str], int] = {}
+    for e in events:
+        key = (e.get("severity", "info"), e.get("kind", "?"))
+        by_kind[key] = by_kind.get(key, 0) + 1
+    lines = ["events"]
+    for (sev, kind), n in sorted(by_kind.items()):
+        lines.append(f"  {sev:<5} {kind:<24} x{n}")
+    notable = [e for e in events
+               if e.get("severity") in ("warn", "error")
+               and e.get("kind") != "convergence_ring"]
+    for e in notable[-max_shown:]:
+        detail = {k: v for k, v in e.items()
+                  if k not in ("t", "kind", "severity")}
+        lines.append(f"  ! {e['severity']} {e['kind']} {detail}")
+    return "\n".join(lines)
+
+
+def render_report(trace: Any = None,
+                  events: Optional[Sequence[Dict[str, Any]]] = None,
+                  snapshot: Optional[Dict[str, Any]] = None) -> str:
+    """The full text report from whichever artifacts exist."""
+    sections = []
+    if snapshot is not None:
+        sections.append(latency_section(snapshot))
+    if trace is not None:
+        sections.append(waterfall_section(trace))
+    if events is not None:
+        sections.append(convergence_section(events))
+        sections.append(events_section(events))
+    if not sections:
+        return "obs_report: no artifacts given (need --trace/--events/--metrics)"
+    rule = "-" * 64
+    return f"\n{rule}\n".join(sections)

@@ -1,0 +1,67 @@
+"""Host-side unrolling of the on-device convergence rings.
+
+With ``SolverParams(ring_size=K)`` the ADMM segment loop records
+``(prim_res, dual_res, rho)`` into a K-slot circular buffer at every
+residual check — *inside* the jitted program, zero host syncs (the
+rings are just three more ``Solution`` output leaves). Slot layout:
+segment ``j`` (0-based) writes slot ``j % K``, so once the solve runs
+more than K segments the ring holds the **last K** checks. This module
+is the host-side decoder: given the rings plus the device-reported
+iteration count it reconstructs the chronological residual trajectory
+and the iteration number of each sample.
+
+First-order QP methods are diagnosed by exactly these trajectories
+(restart behavior, rho adaptation, stall-vs-converge) — see PDQP
+(arXiv:2311.07710) and GPU-ADMM (arXiv:1912.04263); the rings make
+them observable without re-running the solve with host polling.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+def ring_history(ring_prim, ring_dual, ring_rho, iters: int,
+                 check_interval: int) -> Dict[str, Any]:
+    """Decode one problem's rings into a chronological trajectory.
+
+    Returns ``{"iters": [...], "prim_res": [...], "dual_res": [...],
+    "rho": [...]}`` where ``iters[j]`` is the iteration count at which
+    sample ``j`` was taken (the end of its segment). When the solve ran
+    more than ``ring_size`` segments, the earliest samples have been
+    overwritten and the arrays cover only the trailing window.
+    """
+    prim = np.asarray(ring_prim)
+    dual = np.asarray(ring_dual)
+    rho = np.asarray(ring_rho)
+    ring_size = int(prim.shape[-1])
+    segments = int(iters) // int(check_interval)
+    k = min(segments, ring_size)
+    start = segments - k  # first surviving segment index
+    idx = [(start + j) % ring_size for j in range(k)]
+    return {
+        "iters": [(start + j + 1) * int(check_interval) for j in range(k)],
+        "prim_res": [float(prim[i]) for i in idx],
+        "dual_res": [float(dual[i]) for i in idx],
+        "rho": [float(rho[i]) for i in idx],
+    }
+
+
+def solution_ring_history(solution, check_interval: int,
+                          index: Optional[int] = None) -> Optional[Dict]:
+    """Decode the rings off a :class:`porqua_tpu.qp.solve.QPSolution`
+    (or a serve :class:`SolveResult`). ``index`` selects one problem of
+    a batched solution; ``None`` for an unbatched one. Returns ``None``
+    when the solve ran without rings (``ring_size=0``)."""
+    rp = getattr(solution, "ring_prim", None)
+    if rp is None:
+        return None
+    rd, rr = solution.ring_dual, solution.ring_rho
+    iters = solution.iters
+    if index is not None:
+        rp, rd, rr = rp[index], rd[index], rr[index]
+        iters = np.asarray(iters)[index]
+    return ring_history(rp, rd, rr, int(np.asarray(iters)),
+                        check_interval)

@@ -189,6 +189,16 @@ class SolverParams:
     # ruiz.equilibrate_factored. Opt-in (the bench's TPU headline
     # config uses it); "ruiz" stays the general-purpose default.
     scaling_mode: str = "ruiz"
+    # Convergence telemetry: with ring_size=K the segment loop records
+    # (prim_res, dual_res, rho_bar) into a K-slot circular buffer at
+    # every residual check, entirely on device (three more carried
+    # arrays; the ring holds the last K checks once a solve runs longer
+    # than K segments). The default 0 compiles the exact program this
+    # flag did not exist for — the ring fields stay None, which is an
+    # empty pytree subtree, so the traced jaxpr is bit-identical
+    # (pinned by the GC101-103 contracts, which trace both variants).
+    # Decode host-side via porqua_tpu.obs.rings.ring_history.
+    ring_size: int = 0
     polish: bool = True
     polish_delta: float = 1e-7
     polish_refine_steps: int = 3
@@ -210,6 +220,12 @@ class ADMMState(NamedTuple):
     status: jax.Array  # () Status code
     prim_res: jax.Array
     dual_res: jax.Array
+    # Convergence rings (params.ring_size > 0 only; None — an empty
+    # pytree subtree — otherwise, keeping the default program
+    # untouched). Slot j%K holds the residuals/rho of segment j.
+    ring_prim: Optional[jax.Array] = None  # (ring_size,)
+    ring_dual: Optional[jax.Array] = None  # (ring_size,)
+    ring_rho: Optional[jax.Array] = None   # (ring_size,)
 
 
 def _inf_norm(v):
@@ -544,6 +560,10 @@ def admm_solve(qp: CanonicalQP,
     z_init = jnp.dot(qp.C, x_init, precision=_HP)
     w_init = jnp.clip(x_init, qp.lb, qp.ub)
 
+    # ring_size is static (a hashable SolverParams field), so the
+    # default 0 traces the exact pre-telemetry program (ring leaves
+    # stay None = empty subtrees).
+    ring_size = params.ring_size
     init = ADMMState(
         x=x_init, z=z_init, w=w_init, y=y_init, mu=jnp.zeros(n, dtype),
         rho_bar=jnp.asarray(params.rho0, dtype),
@@ -551,6 +571,11 @@ def admm_solve(qp: CanonicalQP,
         status=jnp.asarray(Status.RUNNING, jnp.int32),
         prim_res=jnp.asarray(jnp.inf, dtype),
         dual_res=jnp.asarray(jnp.inf, dtype),
+        ring_prim=jnp.full((ring_size,), jnp.inf, dtype)
+        if ring_size else None,
+        ring_dual=jnp.full((ring_size,), jnp.inf, dtype)
+        if ring_size else None,
+        ring_rho=jnp.zeros((ring_size,), dtype) if ring_size else None,
     )
 
     def one_iteration(carry, solve, rho, rho_b):
@@ -856,6 +881,18 @@ def admm_solve(qp: CanonicalQP,
         else:
             rho_new = state.rho_bar
 
+        if ring_size:
+            # Segment index = iters/check_interval (iters advances by
+            # exactly check_interval per segment); the ring write is a
+            # device-side dynamic-index store — no host participation,
+            # which is the whole point (GC002/GC102 enforce it).
+            slot = jax.lax.rem(state.iters // params.check_interval,
+                               jnp.asarray(ring_size, jnp.int32))
+            ring_prim = state.ring_prim.at[slot].set(r_prim)
+            ring_dual = state.ring_dual.at[slot].set(r_dual)
+            ring_rho = state.ring_rho.at[slot].set(state.rho_bar)
+        else:
+            ring_prim = ring_dual = ring_rho = None
         new_state = ADMMState(
             x=x, z=z, w=w, y=y, mu=mu,
             rho_bar=rho_new,
@@ -863,6 +900,9 @@ def admm_solve(qp: CanonicalQP,
             status=status,
             prim_res=r_prim,
             dual_res=r_dual,
+            ring_prim=ring_prim,
+            ring_dual=ring_dual,
+            ring_rho=ring_rho,
         )
         if params.halpern:
             # HPR-LP-style adaptive restart: re-anchor on sufficient

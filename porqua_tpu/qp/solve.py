@@ -46,6 +46,17 @@ class QPSolution(NamedTuple):
     dual_res: jax.Array   # () unscaled dual residual (inf-norm)
     obj_val: jax.Array    # () 0.5 x'Px + q'x + constant
     duality_gap: jax.Array  # () |primal - dual objective|
+    # Convergence telemetry (params.ring_size > 0 only; None
+    # otherwise — an empty pytree subtree, so the default program and
+    # its output structure are unchanged). Slot j % ring_size holds
+    # segment j's residual check; decode chronologically with
+    # porqua_tpu.obs.rings.ring_history (uses `iters` to locate the
+    # write head). The residuals are the ADMM iterate's — the final
+    # prim_res/dual_res above are recomputed post-polish, so with
+    # polish=False the last ring sample equals them exactly.
+    ring_prim: Optional[jax.Array] = None  # (ring_size,)
+    ring_dual: Optional[jax.Array] = None  # (ring_size,)
+    ring_rho: Optional[jax.Array] = None   # (ring_size,)
 
     @property
     def found(self):
@@ -154,6 +165,9 @@ def _solve_impl(qp: CanonicalQP,
         dual_res=r_dual,
         obj_val=obj,
         duality_gap=gap,
+        ring_prim=state.ring_prim,
+        ring_dual=state.ring_dual,
+        ring_rho=state.ring_rho,
     )
 
 

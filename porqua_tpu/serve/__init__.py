@@ -16,8 +16,13 @@ executables compiled once via ``jit(...).lower(...).compile()``.
         res = svc.result(t, timeout=10.0)    # res.x, res.found, ...
 
 Observability: ``svc.snapshot()`` / ``ServeMetrics.write_jsonl``
-(schema in the :mod:`porqua_tpu.profiling` docstring). Load testing:
-``scripts/serve_loadgen.py`` / :func:`porqua_tpu.serve.loadgen.run_loadgen`.
+(schema in the README's "Observability" section), request span tracing
++ structured events via ``SolveService(obs=porqua_tpu.obs.
+Observability())``, on-device convergence rings via
+``SolverParams(ring_size=K)``, Prometheus scrape endpoint via
+``svc.start_http()``. Load testing: ``scripts/serve_loadgen.py`` /
+:func:`porqua_tpu.serve.loadgen.run_loadgen` (``--trace-out`` /
+``--events-out`` / ``--rings``); render with ``scripts/obs_report.py``.
 """
 
 from porqua_tpu.serve.batcher import (

@@ -76,6 +76,7 @@ class SolveRequest:
     submitted: float                 # monotonic seconds
     deadline: Optional[float] = None  # monotonic seconds, None = none
     warm_key: Optional[str] = None
+    trace_id: Optional[str] = None   # obs span correlation id
 
 
 @dataclasses.dataclass
@@ -92,6 +93,13 @@ class SolveResult:
     latency_s: float
     warm_started: bool
     device: str
+    trace_id: Optional[str] = None
+    # Convergence rings (service params compiled with ring_size > 0
+    # only): this request's raw ring slots; decode chronologically via
+    # porqua_tpu.obs.rings.ring_history(..., iters, check_interval).
+    ring_prim: Optional[np.ndarray] = None
+    ring_dual: Optional[np.ndarray] = None
+    ring_rho: Optional[np.ndarray] = None
 
     @property
     def found(self) -> bool:
@@ -145,10 +153,12 @@ class MicroBatcher:
                  max_batch: int = 64,
                  max_wait_ms: float = 2.0,
                  queue_capacity: int = 4096,
-                 warm_cache: Optional[WarmStartCache] = None) -> None:
+                 warm_cache: Optional[WarmStartCache] = None,
+                 obs=None) -> None:
         self.cache = cache
         self.health = health
         self.metrics = metrics
+        self.obs = obs  # optional porqua_tpu.obs.Observability
         self.max_batch = int(max_batch)
         self.max_wait_s = float(max_wait_ms) * 1e-3
         self.queue: "queue.Queue[Optional[SolveRequest]]" = queue.Queue(
@@ -248,11 +258,19 @@ class MicroBatcher:
 
     def _dispatch(self, bucket: Bucket, reqs: List[SolveRequest]) -> None:
         m = self.metrics
+        obs = self.obs
         now = time.monotonic()
         live: List[SolveRequest] = []
         for r in reqs:
             if r.deadline is not None and now > r.deadline:
                 m.inc("expired")
+                if obs is not None and r.trace_id is not None:
+                    obs.spans.record("queue_wait", r.submitted, now,
+                                     trace_id=r.trace_id, expired=True)
+                    obs.events.emit(
+                        "deadline_expired", "warn", trace_id=r.trace_id,
+                        queued_s=round(now - r.submitted, 4),
+                        late_s=round(now - r.deadline, 4))
                 r.future.set_exception(DeadlineExpired(
                     f"deadline passed {now - r.deadline:.3f}s before "
                     f"dispatch (queued {now - r.submitted:.3f}s)"))
@@ -260,6 +278,13 @@ class MicroBatcher:
                 live.append(r)
         if not live:
             return
+        for r in live:
+            # Aggregate queue-wait seconds (bridged into Tracer.report)
+            # and the per-request span covering submit->batch-formation.
+            m.observe_queue_wait(now - r.submitted)
+            if obs is not None and r.trace_id is not None:
+                obs.spans.record("queue_wait", r.submitted, now,
+                                 trace_id=r.trace_id)
         m.observe_queue_depth(self.queue.qsize() + sum(
             len(d) for d in self._pending.values()))
 
@@ -286,10 +311,12 @@ class MicroBatcher:
                     warm[i] = True
                     m.inc("warm_hits")
 
+        t_exec0 = time.monotonic()
         out = self._execute(bucket, slots, dtype, qp, x0, y0, live)
         if out is None:
             return
         sol, device_label, solve_s = out
+        t_exec1 = time.monotonic()
 
         xs = np.asarray(sol.x)
         ys = np.asarray(sol.y)
@@ -298,11 +325,34 @@ class MicroBatcher:
         prim = np.asarray(sol.prim_res)
         dual = np.asarray(sol.dual_res)
         obj = np.asarray(sol.obj_val)
+        # Convergence rings ride the solution pytree when the service's
+        # SolverParams compiled with ring_size > 0 (None otherwise —
+        # same executable contract as the warm starts: one program).
+        rp = (None if getattr(sol, "ring_prim", None) is None
+              else np.asarray(sol.ring_prim))
+        rd = None if rp is None else np.asarray(sol.ring_dual)
+        rr = None if rp is None else np.asarray(sol.ring_rho)
         done = time.monotonic()
         for i, r in enumerate(live):
             ok = int(status[i]) == Status.SOLVED
             if ok and r.warm_key is not None and self.warm_cache is not None:
                 self.warm_cache.put((r.warm_key, bucket), xs[i], ys[i])
+            # Spans and metrics are recorded BEFORE the future resolves:
+            # a caller synchronizing on result() may export the trace
+            # the moment its last future fires, and the request's own
+            # spans must already be in the recorder by then.
+            m.observe_latency(done - r.submitted)
+            m.inc("completed")
+            if obs is not None and r.trace_id is not None:
+                batch_args = {"bucket": f"{bucket.n}x{bucket.m}",
+                              "slots": slots, "real": len(live),
+                              "device": device_label}
+                obs.spans.record("assemble", now, t_exec0,
+                                 trace_id=r.trace_id, **batch_args)
+                obs.spans.record("solve", t_exec0, t_exec1,
+                                 trace_id=r.trace_id, **batch_args)
+                obs.spans.record("resolve", t_exec1, done,
+                                 trace_id=r.trace_id)
             r.future.set_result(SolveResult(
                 # Copy: the row slice is a view whose .base is the
                 # whole (slots, n) batch array — a caller retaining
@@ -316,9 +366,14 @@ class MicroBatcher:
                 latency_s=done - r.submitted,
                 warm_started=warm[i],
                 device=device_label,
+                trace_id=r.trace_id,
+                ring_prim=None if rp is None else np.array(rp[i],
+                                                           copy=True),
+                ring_dual=None if rd is None else np.array(rd[i],
+                                                           copy=True),
+                ring_rho=None if rr is None else np.array(rr[i],
+                                                          copy=True),
             ))
-            m.observe_latency(done - r.submitted)
-            m.inc("completed")
         m.observe_batch(len(live), slots, solve_s,
                         float(iters[:len(live)].mean()))
 
@@ -347,6 +402,11 @@ class MicroBatcher:
                 # batch loudly and leave the circuit breaker alone —
                 # tripping it would degrade every healthy bucket's
                 # traffic to the fallback device over one cold request.
+                if self.obs is not None:
+                    self.obs.events.emit(
+                        "sanitizer_violation", "error",
+                        what="dispatch", bucket=f"{bucket.n}x{bucket.m}",
+                        detail=str(exc))
                 for r in live:
                     self.metrics.inc("failed")
                     r.future.set_exception(SolveError(f"sanitizer: {exc}"))
@@ -354,6 +414,13 @@ class MicroBatcher:
             except Exception as exc:  # noqa: BLE001 - device faults vary
                 last_exc = exc
                 self.metrics.inc("dispatch_failures")
+                if self.obs is not None:
+                    self.obs.events.emit(
+                        "dispatch_failure", "error",
+                        bucket=f"{bucket.n}x{bucket.m}",
+                        device=(f"{device.platform}:{device.id}"
+                                if device is not None else "default"),
+                        error=f"{type(exc).__name__}: {exc}")
                 if not self.health.record_failure(exc):
                     break  # already on the last-resort device
         for r in live:

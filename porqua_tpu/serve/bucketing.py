@@ -128,9 +128,13 @@ class ExecutableCache:
     """
 
     def __init__(self, params: SolverParams = SolverParams(),
-                 metrics=None) -> None:
+                 metrics=None, events=None) -> None:
         self.params = params
         self.metrics = metrics
+        # Optional porqua_tpu.obs.EventBus: every AOT compile becomes a
+        # structured event (post-warmup ones at "warn" — they are the
+        # steady-state-recompile regression the counters gate on).
+        self.events = events
         self._lock = threading.Lock()
         self._cache: Dict[tuple, object] = {}  # guarded-by: self._lock
         # Sanitizer warmup state, scoped per cache AND per device: a
@@ -178,17 +182,36 @@ class ExecutableCache:
             # recompiles invariant) instead of silently paying a
             # multi-second compile mid-traffic.
             dev_key = self._device_key(device)
-            sanitize.note_compile(
-                f"bucket={bucket} slots={int(slots)} device={dev_key}",
-                post_warmup=(dev_key in self._warmed_devices
-                             and not self._warming.get((bucket, dev_key))))
+            post_warmup = (dev_key in self._warmed_devices
+                           and not self._warming.get((bucket, dev_key)))
+            try:
+                sanitize.note_compile(
+                    f"bucket={bucket} slots={int(slots)} device={dev_key}",
+                    post_warmup=post_warmup)
+            except sanitize.SanitizerError as exc:
+                if self.events is not None:
+                    self.events.emit(
+                        "sanitizer_violation", "error",
+                        what="post_warmup_compile_refused",
+                        bucket=f"{bucket.n}x{bucket.m}",
+                        slots=int(slots), device=str(dev_key),
+                        detail=str(exc))
+                raise
             struct = batch_shape_struct(
                 int(slots), bucket.n, bucket.m, dtype=dtype,
                 factor_rows=bucket.factor_rows)
             exe = aot_compile_batch(struct, self.params, device=device)
             self._cache[key] = exe
+            seconds = time.perf_counter() - t0
             if self.metrics is not None:
-                self.metrics.observe_compile(time.perf_counter() - t0)
+                self.metrics.observe_compile(seconds)
+            if self.events is not None:
+                self.events.emit(
+                    "compile", "warn" if post_warmup else "info",
+                    bucket=f"{bucket.n}x{bucket.m}",
+                    factor_rows=bucket.factor_rows, slots=int(slots),
+                    device=str(dev_key), seconds=round(seconds, 4),
+                    post_warmup=post_warmup)
             return exe, True
 
     @property

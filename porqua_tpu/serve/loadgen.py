@@ -20,6 +20,7 @@ an unbounded backlog.
 
 from __future__ import annotations
 
+import dataclasses
 import threading
 import time
 from typing import Dict, List, Optional
@@ -87,7 +88,11 @@ def run_loadgen(requests: List[CanonicalQP],
                 warm_keys: bool = False,
                 deadline_s: Optional[float] = None,
                 service: Optional[SolveService] = None,
-                jsonl_path: Optional[str] = None) -> Dict:
+                jsonl_path: Optional[str] = None,
+                trace_out: Optional[str] = None,
+                events_out: Optional[str] = None,
+                ring_size: int = 0,
+                ring_samples: int = 8) -> Dict:
     """Drive ``requests`` through a :class:`SolveService`; return the
     report dict (throughput, percentiles, occupancy, recompiles).
 
@@ -100,18 +105,38 @@ def run_loadgen(requests: List[CanonicalQP],
     with its stream index so replaying the stream twice exercises the
     warm-start cache. An externally-managed ``service`` (already
     started) may be passed; otherwise one is created and torn down.
+
+    Observability: ``trace_out`` writes the run's request spans as a
+    Perfetto-loadable Chrome trace (and adds span-coverage figures to
+    the report); ``events_out`` writes the structured event log
+    (JSONL). ``ring_size`` compiles the service's executables with
+    on-device convergence rings and emits a ``convergence_ring`` event
+    for the first ``ring_samples`` completed requests — the data
+    ``scripts/obs_report.py`` renders as sparklines. Both artifacts
+    require the service to be created here (an external ``service``
+    carries its own ``obs``).
     """
     if mode not in ("closed", "open"):
         raise ValueError(f"unknown mode {mode!r}; expected closed|open")
     if mode == "open" and not rate:
         raise ValueError("open-loop mode requires a rate (solves/s)")
 
+    obs = None
     own_service = service is None
     if own_service:
+        if ring_size:
+            params = dataclasses.replace(params, ring_size=int(ring_size))
+        if trace_out or events_out or ring_size:
+            from porqua_tpu.obs import Observability
+
+            obs = Observability()
         service = SolveService(params=params, max_batch=max_batch,
                                max_wait_ms=max_wait_ms,
-                               queue_capacity=max(4 * max_batch, 1024))
+                               queue_capacity=max(4 * max_batch, 1024),
+                               obs=obs)
         service.start()
+    else:
+        obs = service.obs
     try:
         # Prewarm every slot-ladder executable for the stream's bucket,
         # then reset the window: measured `compiles` == recompiles.
@@ -157,10 +182,13 @@ def run_loadgen(requests: List[CanonicalQP],
                 ticket.future.add_done_callback(lambda _f: sem.release())
             tickets.append(ticket)
         solved = 0
+        sampled = []  # first few results, for convergence-ring events
         for ticket in tickets:
             try:
                 res = service.result(ticket, timeout=300)
                 solved += int(res.found)
+                if res.ring_prim is not None and len(sampled) < ring_samples:
+                    sampled.append(res)
             except Exception as exc:  # noqa: BLE001 - reported, not fatal
                 errors.append(f"{type(exc).__name__}: {exc}")
         elapsed = time.perf_counter() - t0
@@ -173,8 +201,44 @@ def run_loadgen(requests: List[CanonicalQP],
         snap = service.snapshot()
         if jsonl_path:
             service.metrics.write_jsonl(jsonl_path)
+
+        obs_fields: Dict = {}
+        if obs is not None:
+            from porqua_tpu.obs.report import coverage_stats
+            from porqua_tpu.obs.rings import ring_history
+
+            for res in sampled:
+                hist = ring_history(res.ring_prim, res.ring_dual,
+                                    res.ring_rho, res.iters,
+                                    service.params.check_interval)
+                obs.events.emit(
+                    "convergence_ring", "info", trace_id=res.trace_id,
+                    iters_final=res.iters,
+                    final_prim_res=res.prim_res,
+                    final_dual_res=res.dual_res, **hist)
+            trace = obs.spans.chrome_trace()
+            cov = coverage_stats(trace)
+            obs_fields = {
+                "trace_events": len(trace["traceEvents"]),
+                "spans_dropped": obs.spans.dropped,
+                "span_cover_median": round(cov["cover_median"], 4),
+                "span_cover_min": round(cov["cover_min"], 4),
+            }
+            if trace_out:
+                # The trace object was just built for the coverage
+                # stats; dump it directly instead of having
+                # SpanRecorder.write rebuild the whole event list.
+                import json as _json
+
+                with open(trace_out, "w") as f:
+                    _json.dump(trace, f)
+                obs_fields["trace_out"] = trace_out
+            if events_out:
+                obs.events.write_jsonl(events_out)
+                obs_fields["events_out"] = events_out
         n = len(requests)
         return {
+            **obs_fields,
             "n_requests": n,
             "n_assets": int(requests[0].n),
             "mode": mode,

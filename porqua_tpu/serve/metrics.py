@@ -4,11 +4,13 @@ One :class:`ServeMetrics` instance is shared by the whole serve stack
 (service / batcher / executable cache / device health) and is the
 single source of truth the load generator and ``bench.py``'s
 ``serving`` config read. The snapshot schema is documented in the
-:mod:`porqua_tpu.profiling` module docstring (the serve layer is that
-module's online counterpart); :meth:`ServeMetrics.bridge_tracer`
+README's "Observability" section (alongside the span and event
+schemas it cross-references); :meth:`ServeMetrics.bridge_tracer`
 re-exports the accumulated stage seconds into an existing
 :class:`porqua_tpu.profiling.Tracer` so serving runs land in the same
-report as one-shot benchmarks.
+report as one-shot benchmarks, and
+:func:`porqua_tpu.obs.prometheus_text` renders a snapshot in the
+Prometheus text exposition format.
 
 Thread-safety: every mutator takes the instance lock — submitters run
 on caller threads, batch observations on the batcher thread, and
@@ -62,7 +64,9 @@ class ServeMetrics:
         with self._lock:
             self.counters: Dict[str, int] = {k: 0 for k in COUNTERS}
             self._latencies: List[float] = []
+            self._latency_observations = 0
             self._solve_seconds = 0.0
+            self._queue_wait_seconds = 0.0
             self._compile_seconds = 0.0
             self._iters_sum = 0.0
             self._iters_n = 0
@@ -106,15 +110,26 @@ class ServeMetrics:
             self._iters_sum += iters_mean * real
             self._iters_n += real
 
+    def observe_queue_wait(self, seconds: float) -> None:
+        """Accumulate one request's submit->dispatch wait (the batcher
+        observes it at batch formation, so the figure covers queue time
+        plus pending-list time — everything before device work)."""
+        with self._lock:
+            self._queue_wait_seconds += seconds
+
     def observe_latency(self, seconds: float) -> None:
         with self._lock:
             if len(self._latencies) < self._reservoir_cap:
                 self._latencies.append(seconds)
             else:
-                # Cheap reservoir: overwrite pseudo-uniformly; the cap
-                # is generous enough that p99 stays faithful.
-                i = self.counters["completed"] % self._reservoir_cap
+                # Cheap reservoir: overwrite round-robin, indexed by the
+                # reservoir's OWN observation counter — `completed` is
+                # incremented on a different code path (and not at all
+                # for some callers), which repeatedly clobbered the same
+                # slot and biased the percentiles.
+                i = self._latency_observations % self._reservoir_cap
                 self._latencies[i] = seconds
+            self._latency_observations += 1
 
     # -- readers -----------------------------------------------------
 
@@ -135,6 +150,7 @@ class ServeMetrics:
                     if self._queue_depth_samples else 0.0),
                 "queue_depth_max": self._queue_depth_max,
                 "solve_seconds": self._solve_seconds,
+                "queue_wait_seconds": self._queue_wait_seconds,
                 "compile_seconds": self._compile_seconds,
                 "iters_mean": (self._iters_sum / self._iters_n
                                if self._iters_n else 0.0),
@@ -163,8 +179,14 @@ class ServeMetrics:
         from porqua_tpu.profiling import StageTiming
 
         snap = self.snapshot()
-        for stage, seconds in (("serve/solve", snap["solve_seconds"]),
-                               ("serve/compile", snap["compile_seconds"])):
+        # queue_wait rides along so Tracer.report() shows where serving
+        # latency actually goes: requests overwhelmingly spend their
+        # lives waiting for a batch slot, not on the device (the spans
+        # measure it per request; this is the window aggregate).
+        for stage, seconds in (
+                ("serve/queue_wait", snap["queue_wait_seconds"]),
+                ("serve/solve", snap["solve_seconds"]),
+                ("serve/compile", snap["compile_seconds"])):
             tracer.timings.append(StageTiming(stage, seconds, {
                 "batches": snap["batches"],
                 "occupancy_mean": round(snap["occupancy_mean"], 4),

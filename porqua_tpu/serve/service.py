@@ -73,7 +73,8 @@ class DeviceHealth:
                  failure_threshold: int = 2,
                  probe_timeout_s: float = 30.0,
                  recovery_interval_s: float = 60.0,
-                 metrics: Optional[ServeMetrics] = None) -> None:
+                 metrics: Optional[ServeMetrics] = None,
+                 events=None) -> None:
         self.primary = jax.devices()[0] if primary is None else primary
         if fallback is None:
             try:
@@ -86,6 +87,9 @@ class DeviceHealth:
         self.probe_timeout_s = float(probe_timeout_s)
         self.recovery_interval_s = float(recovery_interval_s)
         self.metrics = metrics
+        # Optional porqua_tpu.obs.EventBus: circuit-breaker transitions
+        # and probe failures become structured events.
+        self.events = events
         self._lock = threading.Lock()
         self._failures = 0            # guarded-by: self._lock
         self._degraded = False        # guarded-by: self._lock
@@ -117,8 +121,14 @@ class DeviceHealth:
         t.start()
         t.join(self.probe_timeout_s)
         ok = bool(result and result[0])
-        if not ok and self.metrics is not None:
-            self.metrics.inc("probe_failures")
+        if not ok:
+            if self.metrics is not None:
+                self.metrics.inc("probe_failures")
+            if self.events is not None:
+                self.events.emit(
+                    "probe_failure", "warn",
+                    device=f"{device.platform}:{device.id}",
+                    timeout_s=self.probe_timeout_s)
         return ok
 
     def _trip(self) -> None:  # guarded-by: self._lock
@@ -126,6 +136,12 @@ class DeviceHealth:
         self._opened_at = time.monotonic()
         if self.metrics is not None:
             self.metrics.inc("device_switches")
+        if self.events is not None:
+            self.events.emit(
+                "breaker_open", "error",
+                primary=f"{self.primary.platform}:{self.primary.id}",
+                fallback=f"{self.fallback.platform}:{self.fallback.id}",
+                failures=self._failures)
         self._publish()
 
     # -- API ---------------------------------------------------------
@@ -177,6 +193,11 @@ class DeviceHealth:
                 self._failures = 0
                 if self.metrics is not None:
                     self.metrics.inc("device_switches")
+                if self.events is not None:
+                    self.events.emit(
+                        "breaker_close", "info",
+                        primary=f"{self.primary.platform}:"
+                                f"{self.primary.id}")
                 self._publish()
             else:
                 self._opened_at = time.monotonic()
@@ -222,19 +243,34 @@ class SolveService:
                  fingerprint_warm_keys: bool = False,
                  metrics: Optional[ServeMetrics] = None,
                  health: Optional[DeviceHealth] = None,
+                 obs=None,
                  **health_kwargs) -> None:
         self.params = params
         self.fingerprint_warm_keys = bool(fingerprint_warm_keys)
         self.ladder = BucketLadder() if ladder is None else ladder
         self.metrics = ServeMetrics() if metrics is None else metrics
-        self.health = (DeviceHealth(metrics=self.metrics, **health_kwargs)
+        # Optional porqua_tpu.obs.Observability: spans are recorded for
+        # every request (trace ids minted at submit) and structured
+        # events emitted by every layer. None = zero overhead.
+        self.obs = obs
+        events = None if obs is None else obs.events
+        self.health = (DeviceHealth(metrics=self.metrics, events=events,
+                                    **health_kwargs)
                        if health is None else health)
-        self.cache = ExecutableCache(params, metrics=self.metrics)
+        if health is not None and events is not None \
+                and self.health.events is None:
+            # An externally-built health manager still reports through
+            # this service's bus unless it already has its own.
+            self.health.events = events
+        self.cache = ExecutableCache(params, metrics=self.metrics,
+                                     events=events)
         self.batcher = MicroBatcher(
             self.cache, self.health, self.metrics,
             max_batch=max_batch, max_wait_ms=max_wait_ms,
             queue_capacity=queue_capacity,
-            warm_cache=WarmStartCache(warm_capacity) if warm_start else None)
+            warm_cache=WarmStartCache(warm_capacity) if warm_start else None,
+            obs=obs)
+        self._http = None
         self._started = False
 
     # -- lifecycle ---------------------------------------------------
@@ -246,9 +282,37 @@ class SolveService:
         return self
 
     def stop(self, timeout: Optional[float] = 30.0) -> None:
+        if self._http is not None:
+            self._http.stop()
+            self._http = None
         if self._started:
             self.batcher.stop(timeout=timeout)
             self._started = False
+
+    def start_http(self, port: int = 0, host: str = "127.0.0.1") -> int:
+        """Expose ``/metrics`` (Prometheus text) and ``/healthz``
+        (JSON) on a stdlib HTTP daemon thread; returns the bound port
+        (pass ``port=0`` for an ephemeral one). Stopped by ``stop()``.
+        """
+        from porqua_tpu.obs.exposition import ObsHTTPServer, prometheus_text
+
+        if self._http is None:
+            self._http = ObsHTTPServer(
+                metrics_fn=lambda: prometheus_text(self.snapshot()),
+                health_fn=self._health_payload, host=host, port=port)
+        return self._http.start()
+
+    def _health_payload(self) -> dict:
+        # Degraded-but-serving is still ok=True: the breaker exists so
+        # requests keep completing on the fallback; ejecting the
+        # instance for being degraded would turn a slowdown into an
+        # outage. ok flips only when the service is not running.
+        return {
+            "ok": self._started,
+            "started": self._started,
+            "degraded": self.health.degraded,
+            "device": self.metrics.snapshot().get("device"),
+        }
 
     def __enter__(self) -> "SolveService":
         return self.start()
@@ -305,6 +369,9 @@ class SolveService:
         rebalances over the same polytope warm-start automatically."""
         if not self._started:
             raise RuntimeError("service not started (use `with service:`)")
+        t0 = time.monotonic()
+        trace_id = (None if self.obs is None
+                    else self.obs.spans.new_trace())
         if warm_key is None and self.fingerprint_warm_keys:
             warm_key = problem_fingerprint(qp)
         bucket, padded = self.ladder.pad(qp)
@@ -313,7 +380,7 @@ class SolveService:
             qp=padded, bucket=bucket, n_orig=qp.n, m_orig=qp.m,
             future=Future(), submitted=now,
             deadline=None if deadline_s is None else now + deadline_s,
-            warm_key=warm_key)
+            warm_key=warm_key, trace_id=trace_id)
         try:
             if timeout is None:
                 self.batcher.queue.put(req)
@@ -321,11 +388,25 @@ class SolveService:
                 self.batcher.queue.put(req, timeout=timeout)
         except _queue.Full:
             self.metrics.inc("rejected")
+            if self.obs is not None:
+                self.obs.events.emit(
+                    "backpressure_reject", "warn", trace_id=trace_id,
+                    queue_capacity=self.batcher.queue.maxsize,
+                    bucket=f"{bucket.n}x{bucket.m}")
             raise QueueFull(
                 f"submission queue at capacity "
                 f"({self.batcher.queue.maxsize}); shed load or raise "
                 f"queue_capacity") from None
         self.metrics.inc("submitted")
+        if self.obs is not None:
+            # The submit span covers fingerprint + bucket-pad + enqueue;
+            # its end abuts `submitted`, so a request's spans (submit ->
+            # queue_wait -> assemble -> solve -> resolve) tile its whole
+            # wall-clock with no gaps.
+            self.obs.spans.record("submit", t0, now,
+                                  trace_id=trace_id,
+                                  bucket=f"{bucket.n}x{bucket.m}",
+                                  n=qp.n, m=qp.m)
         return Ticket(future=req.future, submitted=now)
 
     def result(self, ticket: Ticket,

@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Render a traced serving run: waterfall, latency table, sparklines.
+
+Consumes the artifacts a traced run emits and prints one text report:
+
+* ``--trace trace.json`` — the Chrome-trace span file
+  (``serve_loadgen.py --trace-out`` / ``SpanRecorder.write``):
+  aggregated stage waterfall + per-request span coverage.
+* ``--events events.jsonl`` — the structured event log
+  (``--events-out`` / ``EventBus.write_jsonl``): severity rollup,
+  notable warn/error lines, and convergence sparklines from
+  ``convergence_ring`` events (``--rings K`` on the load generator).
+* ``--metrics serve.jsonl`` — metrics snapshots
+  (``ServeMetrics.write_jsonl``; the last line is rendered).
+
+``--selftest`` builds a synthetic run in-process (no JAX, no service)
+and checks the rendering pipeline end to end — the cheap CI smoke
+``scripts/run_tests.sh`` runs.
+
+Examples::
+
+    JAX_PLATFORMS=cpu python scripts/serve_loadgen.py \\
+        --trace-out /tmp/trace.json --events-out /tmp/events.jsonl --rings 16
+    python scripts/obs_report.py --trace /tmp/trace.json \\
+        --events /tmp/events.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _selftest() -> int:
+    """Exercise record -> export -> load -> render on synthetic data."""
+    from porqua_tpu.obs import Observability, load_jsonl, render_report
+    from porqua_tpu.obs.report import coverage_stats, sparkline
+
+    obs = Observability()
+    # Eight fake requests with contiguous submit/queue_wait/assemble/
+    # solve/resolve spans — coverage must come out exactly 1.0.
+    for i in range(8):
+        t0 = 10.0 + 0.01 * i
+        tid = obs.spans.new_trace()
+        edges = [t0, t0 + 0.0002, t0 + 0.004 + 0.001 * i,
+                 t0 + 0.0045 + 0.001 * i, t0 + 0.007 + 0.001 * i,
+                 t0 + 0.0072 + 0.001 * i]
+        for name, a, b in zip(
+                ("submit", "queue_wait", "assemble", "solve", "resolve"),
+                edges[:-1], edges[1:]):
+            obs.spans.record(name, a, b, trace_id=tid, bucket="32x8")
+        obs.events.emit("convergence_ring", trace_id=tid,
+                        iters_final=25 * (i + 2),
+                        iters=[25 * (j + 1) for j in range(i + 2)],
+                        prim_res=[10.0 ** -(j + 1) for j in range(i + 2)],
+                        dual_res=[10.0 ** -(j + 2) for j in range(i + 2)],
+                        rho=[0.1] * (i + 2))
+    obs.events.emit("compile", bucket="32x8", slots=8, seconds=0.5)
+    obs.events.emit("breaker_open", "error", primary="tpu:0",
+                    fallback="cpu:0", failures=2)
+
+    trace = obs.spans.chrome_trace()
+    cov = coverage_stats(trace)
+    assert cov["n_traces"] == 8, cov
+    assert abs(cov["cover_median"] - 1.0) < 1e-6, cov
+    assert abs(cov["cover_min"] - 1.0) < 1e-6, cov
+    assert sparkline([1e-1, 1e-3, 1e-6], log=True)  # renders non-empty
+
+    # Round-trip through the on-disk formats the real artifacts use.
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        tpath = os.path.join(td, "trace.json")
+        epath = os.path.join(td, "events.jsonl")
+        obs.write(trace_path=tpath, events_path=epath)
+        with open(tpath) as f:
+            trace = json.load(f)
+        events = load_jsonl(epath)
+
+    snapshot = {"completed": 8, "failed": 0, "expired": 0, "rejected": 0,
+                "throughput_solves_per_s": 1100.0, "latency_p50_ms": 4.2,
+                "latency_p90_ms": 8.0, "latency_p99_ms": 9.9,
+                "occupancy_mean": 0.91, "queue_wait_seconds": 0.03,
+                "solve_seconds": 0.02, "compiles": 0,
+                "device": "cpu:0", "degraded": False}
+    text = render_report(trace=trace, events=events, snapshot=snapshot)
+    for needle in ("stage waterfall", "queue_wait", "span coverage",
+                   "convergence rings", "breaker_open",
+                   "latency / throughput"):
+        assert needle in text, f"selftest: {needle!r} missing from report"
+    print(text)
+    print("\nobs_report selftest: ok")
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--trace", default=None,
+                    help="Chrome-trace span file (serve_loadgen --trace-out)")
+    ap.add_argument("--events", default=None,
+                    help="event JSONL (serve_loadgen --events-out)")
+    ap.add_argument("--metrics", default=None,
+                    help="metrics snapshot JSONL (last line is rendered)")
+    ap.add_argument("--selftest", action="store_true",
+                    help="render a synthetic run and verify the pipeline")
+    args = ap.parse_args()
+
+    if args.selftest:
+        return _selftest()
+
+    from porqua_tpu.obs import load_jsonl, render_report
+
+    trace = events = snapshot = None
+    if args.trace:
+        with open(args.trace) as f:
+            trace = json.load(f)
+    if args.events:
+        events = load_jsonl(args.events)
+    if args.metrics:
+        lines = load_jsonl(args.metrics)
+        snapshot = lines[-1] if lines else None
+
+    print(render_report(trace=trace, events=events, snapshot=snapshot))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

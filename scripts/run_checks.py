@@ -4,10 +4,14 @@
 Usage:
     python scripts/run_checks.py [paths ...] [options]
 
-Defaults to scanning ``porqua_tpu/`` with every AST rule (GC001-GC006)
-plus the trace-time jaxpr contracts (GC101-GC103) against the real
-batch entry points on the XLA-CPU backend. Exit status: 0 clean,
-1 findings, 2 internal/usage error.
+Defaults to scanning ``porqua_tpu/`` — every package subtree,
+including the observability stack ``porqua_tpu/obs/`` (which must scan
+clean with zero suppressions, same bar as the solver) — with every AST
+rule (GC001-GC006) plus the trace-time jaxpr contracts (GC101-GC103)
+against the real batch entry points on the XLA-CPU backend, both with
+default solver params and with the convergence-ring telemetry enabled
+(``SolverParams(ring_size>0)``). Exit status: 0 clean, 1 findings,
+2 internal/usage error.
 
 Options:
     --format {text,json}   output format (default text)

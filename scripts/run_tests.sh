@@ -9,11 +9,24 @@ cd "$(dirname "$0")/.."
 fail=0
 
 # graftcheck: the static-analysis + jaxpr-contract gate runs everywhere
-# the tests do (rule docs: README "Static analysis & sanitizers").
+# the tests do (rule docs: README "Static analysis & sanitizers"). The
+# porqua_tpu scan set includes porqua_tpu/obs (zero suppressions), and
+# the jaxpr contracts trace the telemetry-enabled (ring_size>0) batch
+# entry points alongside the defaults.
 if out=$(timeout 600 python scripts/run_checks.py porqua_tpu 2>&1); then
     echo "OK   graftcheck: $(echo "$out" | tail -1)"
 else
     echo "FAIL graftcheck:"
+    echo "$out"
+    fail=1
+fi
+
+# obs_report: the observability rendering pipeline (synthetic spans,
+# events, sparklines — no JAX backend) must keep rendering.
+if out=$(timeout 120 python scripts/obs_report.py --selftest 2>&1); then
+    echo "OK   obs_report --selftest: $(echo "$out" | tail -1)"
+else
+    echo "FAIL obs_report --selftest:"
     echo "$out"
     fail=1
 fi

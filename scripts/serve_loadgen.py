@@ -18,6 +18,8 @@ Examples::
     python scripts/serve_loadgen.py --workload northstar --requests 252
     python scripts/serve_loadgen.py --mode open --rate 2000 --duration-requests 8192
     python scripts/serve_loadgen.py --warm-keys --jsonl serve_metrics.jsonl
+    python scripts/serve_loadgen.py --trace-out trace.json \\
+        --events-out events.jsonl --rings 16   # then: scripts/obs_report.py
 
 Prints one JSON report line on stdout (diagnostics on stderr), in the
 same one-line-artifact style as ``bench.py``.
@@ -52,6 +54,19 @@ def main() -> int:
     ap.add_argument("--deadline-s", type=float, default=None)
     ap.add_argument("--jsonl", default=None,
                     help="append the final metrics snapshot to this file")
+    ap.add_argument("--trace-out", default=None,
+                    help="write per-request spans as Chrome-trace JSON "
+                         "(load in Perfetto / chrome://tracing, or "
+                         "render with scripts/obs_report.py)")
+    ap.add_argument("--events-out", default=None,
+                    help="write the structured event log (JSONL: "
+                         "compiles, breaker transitions, expiries, "
+                         "convergence-ring samples)")
+    ap.add_argument("--rings", type=int, default=0, metavar="K",
+                    help="compile with K-slot on-device convergence "
+                         "rings and emit ring events for a sample of "
+                         "requests (0 = off, the bit-identical default "
+                         "program)")
     ap.add_argument("--seed", type=int, default=5)
     ap.add_argument("--factor", action="store_true",
                     help="carry the low-rank objective factor (Pf = X) "
@@ -73,7 +88,8 @@ def main() -> int:
         requests, mode=args.mode, rate=args.rate, inflight=args.inflight,
         max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
         warm_keys=args.warm_keys, deadline_s=args.deadline_s,
-        jsonl_path=args.jsonl)
+        jsonl_path=args.jsonl, trace_out=args.trace_out,
+        events_out=args.events_out, ring_size=args.rings)
     report["workload"] = args.workload
     print(json.dumps(report))
     return 0 if report["errors"] == 0 else 1

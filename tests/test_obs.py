@@ -1,0 +1,392 @@
+"""Unified observability (porqua_tpu.obs): span recorder + Chrome
+trace export, event bus, Prometheus exposition + HTTP endpoint,
+on-device convergence rings, and the end-to-end traced serve path.
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from porqua_tpu.obs import (
+    EventBus,
+    Observability,
+    ObsHTTPServer,
+    SpanRecorder,
+    load_jsonl,
+    prometheus_text,
+)
+from porqua_tpu.obs.report import (
+    coverage_stats,
+    render_report,
+    span_aggregate,
+    sparkline,
+)
+from porqua_tpu.obs.rings import ring_history, solution_ring_history
+from porqua_tpu.qp.canonical import CanonicalQP
+from porqua_tpu.qp.solve import SolverParams, solve_qp, solve_qp_batch
+
+
+def make_qp(n=6, m=2, seed=0, dtype=np.float32):
+    rng = np.random.default_rng(seed)
+    A = rng.standard_normal((2 * n, n))
+    P = A.T @ A / (2 * n) + np.eye(n)
+    q = rng.standard_normal(n)
+    C = np.concatenate([np.ones((1, n)), rng.standard_normal((m - 1, n))])
+    return CanonicalQP.build(
+        P, q, C=C, l=np.full(m, -1.0), u=np.ones(m),
+        lb=np.zeros(n), ub=np.ones(n), dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# span recorder
+# ---------------------------------------------------------------------------
+
+class TestSpanRecorder:
+    def test_record_and_chrome_export(self):
+        rec = SpanRecorder()
+        tid = rec.new_trace()
+        assert tid != rec.new_trace()  # unique per mint
+        rec.record("queue_wait", 1.0, 1.5, trace_id=tid, bucket="8x4")
+        with rec.span("solve", trace_id=tid):
+            pass
+        spans = rec.spans()
+        assert [s.name for s in spans] == ["queue_wait", "solve"]
+        assert spans[0].duration == pytest.approx(0.5)
+
+        trace = rec.chrome_trace()
+        events = trace["traceEvents"]
+        assert len(events) == 2
+        for e in events:
+            assert e["ph"] == "X"
+            assert e["args"]["trace_id"] == tid
+        assert events[0]["dur"] == pytest.approx(0.5e6)
+        assert events[0]["args"]["bucket"] == "8x4"
+        # Loadable: a straight json round-trip preserves the structure.
+        again = json.loads(json.dumps(trace))
+        assert len(again["traceEvents"]) == 2
+
+    def test_bounded_capacity_counts_drops(self):
+        rec = SpanRecorder(capacity=2)
+        for i in range(5):
+            rec.record("s", i, i + 1)
+        assert len(rec.spans()) == 2
+        assert rec.dropped == 3
+        assert rec.chrome_trace()["metadata"]["dropped_spans"] == 3
+
+    def test_by_trace_groups_chronologically(self):
+        rec = SpanRecorder()
+        t1, t2 = rec.new_trace(), rec.new_trace()
+        rec.record("b", 2.0, 3.0, trace_id=t1)
+        rec.record("a", 1.0, 2.0, trace_id=t1)
+        rec.record("a", 1.0, 2.0, trace_id=t2)
+        rec.record("anon", 0.0, 1.0)  # no trace id: excluded
+        grouped = rec.by_trace()
+        assert set(grouped) == {t1, t2}
+        assert [s.name for s in grouped[t1]] == ["a", "b"]
+
+
+# ---------------------------------------------------------------------------
+# event bus
+# ---------------------------------------------------------------------------
+
+class TestEventBus:
+    def test_emit_filter_and_jsonl(self, tmp_path):
+        bus = EventBus()
+        bus.emit("compile", "info", bucket="8x4", seconds=0.1)
+        bus.emit("breaker_open", "error", trace_id="t-1", failures=2)
+        bus.emit("deadline_expired", "warn")
+        assert len(bus.events()) == 3
+        assert [e["kind"] for e in bus.events(min_severity="warn")] == [
+            "breaker_open", "deadline_expired"]
+        assert bus.events(kind="compile")[0]["bucket"] == "8x4"
+        assert bus.events(kind="breaker_open")[0]["trace_id"] == "t-1"
+
+        path = tmp_path / "events.jsonl"
+        assert bus.write_jsonl(str(path)) == 3
+        back = load_jsonl(str(path))
+        assert [e["kind"] for e in back] == [
+            "compile", "breaker_open", "deadline_expired"]
+
+    def test_bounded_keeps_newest_and_coerces_severity(self):
+        bus = EventBus(capacity=2)
+        for i in range(4):
+            bus.emit("e", "not-a-severity", i=i)
+        assert len(bus.events()) == 2
+        assert bus.dropped == 2
+        # Ring semantics: the NEWEST events survive (the breaker flip
+        # that just happened is what a diagnostic read needs).
+        assert [e["i"] for e in bus.events()] == [2, 3]
+        assert bus.events()[0]["severity"] == "info"  # coerced
+
+    def test_streaming_sink(self, tmp_path):
+        path = tmp_path / "stream.jsonl"
+        bus = EventBus(path=str(path))
+        bus.emit("a")
+        bus.emit("b")
+        bus.close()
+        assert [e["kind"] for e in load_jsonl(str(path))] == ["a", "b"]
+
+
+# ---------------------------------------------------------------------------
+# exposition
+# ---------------------------------------------------------------------------
+
+class TestExposition:
+    def test_prometheus_text_types_and_device(self):
+        from porqua_tpu.serve import ServeMetrics
+
+        m = ServeMetrics()
+        m.inc("submitted", 3)
+        m.observe_latency(0.01)
+        m.set_device("cpu:0", degraded=True)
+        text = prometheus_text(m.snapshot())
+        assert "# TYPE porqua_serve_submitted counter" in text
+        assert "porqua_serve_submitted 3" in text
+        assert "# TYPE porqua_serve_latency_p50_ms gauge" in text
+        assert "porqua_serve_degraded 1" in text
+        assert 'porqua_serve_device_info{device="cpu:0"} 1' in text
+        # No free-form strings leak in as metric samples.
+        for line in text.splitlines():
+            if not line.startswith("#") and "device_info" not in line:
+                float(line.rsplit(" ", 1)[1])
+
+    def test_http_server_metrics_and_healthz(self):
+        health = {"ok": True, "degraded": False}
+        srv = ObsHTTPServer(lambda: "m 1\n", lambda: health, port=0)
+        port = srv.start()
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10).read()
+            assert body == b"m 1\n"
+            got = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10).read())
+            assert got["ok"] is True
+            health["ok"] = False  # unhealthy flips to 503
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=10)
+            assert exc.value.code == 503
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/nope", timeout=10)
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# convergence rings
+# ---------------------------------------------------------------------------
+
+class TestConvergenceRings:
+    def test_default_program_has_no_rings(self):
+        sol = solve_qp(make_qp(), SolverParams(polish=False))
+        assert sol.ring_prim is None
+        assert solution_ring_history(sol, 25) is None
+
+    def test_ring_matches_final_residuals(self):
+        """The acceptance bar: the last ring sample IS the reported
+        final residual pair (polish off — the rings record the ADMM
+        iterate; the post-polish recompute is a different point)."""
+        params = SolverParams(polish=False, ring_size=8)
+        sol = solve_qp(make_qp(seed=3), params)
+        assert int(sol.status) == 1
+        hist = solution_ring_history(sol, params.check_interval)
+        assert hist["iters"][-1] == int(sol.iters)
+        assert hist["prim_res"][-1] == pytest.approx(float(sol.prim_res),
+                                                     rel=0, abs=0)
+        assert hist["dual_res"][-1] == pytest.approx(float(sol.dual_res),
+                                                     rel=0, abs=0)
+        # Residuals decay along the trajectory; rho starts at rho0.
+        assert hist["prim_res"][0] > hist["prim_res"][-1]
+        assert hist["rho"][0] == pytest.approx(params.rho0)
+
+    def test_ring_solution_identical_to_default(self):
+        """ring_size only APPENDS outputs: x/status/iters are bitwise
+        the program the flag did not exist for."""
+        base = solve_qp(make_qp(seed=5), SolverParams(polish=False))
+        ringed = solve_qp(make_qp(seed=5),
+                          SolverParams(polish=False, ring_size=4))
+        np.testing.assert_array_equal(np.asarray(base.x),
+                                      np.asarray(ringed.x))
+        assert int(base.iters) == int(ringed.iters)
+
+    def test_ring_batched(self):
+        params = SolverParams(polish=False, ring_size=6)
+        qps = [make_qp(seed=s) for s in (7, 8, 9)]
+        from porqua_tpu.qp.canonical import stack_qps
+
+        sol = solve_qp_batch(stack_qps(qps), params)
+        assert np.asarray(sol.ring_prim).shape == (3, 6)
+        for i in range(3):
+            hist = solution_ring_history(sol, params.check_interval,
+                                         index=i)
+            assert hist["prim_res"][-1] == pytest.approx(
+                float(np.asarray(sol.prim_res)[i]), rel=0, abs=0)
+
+    def test_ring_history_wraparound(self):
+        """Synthetic decode check: 5 segments into a 3-ring keeps the
+        last 3 samples in chronological order."""
+        K, ci = 3, 25
+        prim = np.zeros(K)
+        dual = np.zeros(K)
+        rho = np.zeros(K)
+        for j in range(5):  # segment j writes slot j % K
+            prim[j % K] = 10.0 ** -(j + 1)
+            dual[j % K] = 10.0 ** -(j + 2)
+            rho[j % K] = j + 1.0
+        hist = ring_history(prim, dual, rho, iters=5 * ci,
+                            check_interval=ci)
+        assert hist["iters"] == [3 * ci, 4 * ci, 5 * ci]
+        assert hist["prim_res"] == pytest.approx([1e-3, 1e-4, 1e-5])
+        assert hist["rho"] == pytest.approx([3.0, 4.0, 5.0])
+
+
+# ---------------------------------------------------------------------------
+# traced serve path end to end
+# ---------------------------------------------------------------------------
+
+class TestTracedService:
+    def test_spans_tile_request_wallclock_and_events_flow(self):
+        from porqua_tpu.serve import BucketLadder, SolveService
+
+        obs = Observability()
+        params = SolverParams(max_iter=500, eps_abs=1e-5, eps_rel=1e-5,
+                              polish=False, ring_size=4)
+        svc = SolveService(params=params,
+                           ladder=BucketLadder((8, 16), (4, 8)),
+                           max_batch=4, max_wait_ms=5.0, obs=obs)
+        with svc:
+            results = [svc.solve(make_qp(seed=s), timeout=120)
+                       for s in range(4)]
+        assert all(r.found for r in results)
+        # Every result carries its trace id + rings.
+        for r in results:
+            assert r.trace_id is not None
+            assert r.ring_prim is not None
+            hist = ring_history(r.ring_prim, r.ring_dual, r.ring_rho,
+                                r.iters, params.check_interval)
+            # Within f32 rounding: the AOT serve program may fuse the
+            # segment's residual check and the final recompute
+            # differently (observed one-ulp differences), unlike the
+            # jit path where the two are bitwise equal.
+            assert hist["prim_res"][-1] == pytest.approx(r.prim_res,
+                                                         rel=1e-5)
+            assert hist["dual_res"][-1] == pytest.approx(r.dual_res,
+                                                         rel=1e-5)
+        # Spans: the 5-stage pipeline per request, tiling its life.
+        grouped = obs.spans.by_trace()
+        ids = {r.trace_id for r in results}
+        assert ids <= set(grouped)
+        for r in results:
+            spans = grouped[r.trace_id]
+            assert [s.name for s in spans] == [
+                "submit", "queue_wait", "assemble", "solve", "resolve"]
+            total = sum(s.duration for s in spans)
+            extent = spans[-1].t_end - spans[0].t_start
+            assert total == pytest.approx(extent, rel=1e-6)
+            # ...and the instrumented latency is inside the extent.
+            assert r.latency_s <= extent + 1e-6
+        cov = coverage_stats(obs.spans.chrome_trace())
+        assert cov["cover_median"] == pytest.approx(1.0, abs=1e-6)
+        # Events: the prewarm-less cold path logged its compiles.
+        compiles = obs.events.events(kind="compile")
+        assert compiles and all(e["severity"] == "info" for e in compiles)
+
+    def test_expiry_and_backpressure_events(self):
+        from porqua_tpu.serve import (BucketLadder, QueueFull,
+                                      SolveService)
+
+        obs = Observability()
+        params = SolverParams(max_iter=200, polish=False)
+        svc = SolveService(params=params,
+                           ladder=BucketLadder((8, 16), (4, 8)),
+                           max_batch=4, max_wait_ms=150.0,
+                           queue_capacity=1, obs=obs)
+        svc._started = True  # no batcher: force queue/deadline paths
+        svc.submit(make_qp(seed=1))
+        with pytest.raises(QueueFull):
+            svc.submit(make_qp(seed=2), timeout=0.05)
+        rejects = obs.events.events(kind="backpressure_reject")
+        assert len(rejects) == 1 and rejects[0]["severity"] == "warn"
+
+        import time as _time
+        from concurrent.futures import Future
+
+        from porqua_tpu.serve.batcher import DeadlineExpired, SolveRequest
+
+        # Feed one already-expired request straight into the dispatch.
+        bucket, padded = svc.ladder.pad(make_qp(seed=3))
+        now = _time.monotonic()
+        req = SolveRequest(qp=padded, bucket=bucket, n_orig=6, m_orig=2,
+                           future=Future(), submitted=now - 1.0,
+                           deadline=now - 0.5,
+                           trace_id=obs.spans.new_trace())
+        svc.batcher._dispatch(bucket, [req])
+        with pytest.raises(DeadlineExpired):
+            req.future.result(timeout=0)
+        expiries = obs.events.events(kind="deadline_expired")
+        assert len(expiries) == 1
+        assert expiries[0]["trace_id"] == req.trace_id
+
+    def test_service_http_endpoint(self):
+        from porqua_tpu.serve import BucketLadder, SolveService
+
+        params = SolverParams(max_iter=200, polish=False)
+        svc = SolveService(params=params,
+                           ladder=BucketLadder((8, 16), (4, 8)),
+                           max_batch=4)
+        with svc:
+            port = svc.start_http(0)
+            svc.solve(make_qp(seed=11), timeout=120)
+            text = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10
+            ).read().decode()
+            assert "porqua_serve_completed 1" in text
+            health = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10).read())
+            assert health["ok"] is True and health["degraded"] is False
+        # stop() took the endpoint down with the service.
+        with pytest.raises(Exception):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=2)
+
+
+# ---------------------------------------------------------------------------
+# report rendering
+# ---------------------------------------------------------------------------
+
+class TestReport:
+    def test_sparkline_log_scale(self):
+        line = sparkline([1.0, 1e-2, 1e-4, 1e-6], log=True)
+        assert len(line) == 4
+        assert line[0] == "█" and line[-1] == "▁"
+        assert sparkline([]) == ""
+        assert sparkline([5.0, 5.0]) == "▁▁"
+
+    def test_render_report_sections(self):
+        rec = SpanRecorder()
+        tid = rec.new_trace()
+        for name, a, b in (("submit", 0.0, 0.1), ("queue_wait", 0.1, 0.4),
+                           ("solve", 0.4, 0.9), ("resolve", 0.9, 1.0)):
+            rec.record(name, a, b, trace_id=tid)
+        agg = span_aggregate(rec.chrome_trace())
+        assert agg["queue_wait"]["total_ms"] == pytest.approx(300.0)
+        events = [
+            {"t": 0, "kind": "convergence_ring", "severity": "info",
+             "iters_final": 50, "iters": [25, 50],
+             "prim_res": [1e-2, 1e-6], "dual_res": [1e-3, 1e-7],
+             "rho": [0.1, 0.2]},
+            {"t": 0, "kind": "breaker_open", "severity": "error",
+             "primary": "tpu:0"},
+        ]
+        snapshot = {"completed": 1, "latency_p50_ms": 1.0,
+                    "queue_wait_seconds": 0.3, "compiles": 0}
+        text = render_report(trace=rec.chrome_trace(), events=events,
+                             snapshot=snapshot)
+        for needle in ("stage waterfall", "span coverage",
+                       "convergence rings", "breaker_open",
+                       "latency / throughput"):
+            assert needle in text

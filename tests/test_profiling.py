@@ -4,6 +4,7 @@ import io
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from porqua_tpu.profiling import Tracer, solve_stats, timed_stages
 from porqua_tpu.qp.canonical import CanonicalQP
@@ -51,6 +52,32 @@ class TestTimedStages:
                               "execute_first", "execute"}
         assert all(v >= 0 for v in stats.values())
 
+    def test_steady_state_inputs_are_perturbed(self):
+        """The `execute` run must not replay `execute_first`'s exact
+        inputs (measure_device discipline: identical inputs can be
+        aliased away by the tunnel/XLA). io_callback runs on every
+        execution, so it observes the input each compiled run actually
+        received: the two executions must differ."""
+        import jax
+        from jax.experimental import io_callback
+
+        seen = []
+
+        def record(x):
+            seen.append(float(np.asarray(x).sum()))
+            return np.float32(0.0)
+
+        def fn(x):
+            tap = io_callback(record, jax.ShapeDtypeStruct((), jnp.float32),
+                              x, ordered=True)
+            return x.sum() + tap
+
+        base = jnp.zeros((4,), jnp.float32)
+        timed_stages(fn, base)
+        assert len(seen) == 2  # execute_first + execute
+        assert seen[0] == 0.0
+        assert seen[1] != seen[0]  # perturbed, not a replay
+
 
 class TestSolveStats:
     def test_rollup(self, rng):
@@ -84,3 +111,29 @@ def test_flop_model_scaling_and_kernel_modes():
     # The capacitance build is identical XLA work on both backends.
     assert (pal["flops_breakdown"]["factorize"]
             == xla["flops_breakdown"]["factorize"])
+
+
+def test_flop_model_rejects_unknown_scaling_mode():
+    """Same contract as qp.solve: a typo'd mode silently counted as
+    Ruiz would quote a wrong roofline with no error."""
+    from porqua_tpu.profiling import admm_flop_model
+
+    with pytest.raises(ValueError, match="scaling_mode"):
+        admm_flop_model(n=16, m=2, window=8, iters=25.0,
+                        scaling_mode="ruizz")
+
+
+def test_device_peaks_lookup_and_unknown_fallback():
+    from porqua_tpu.profiling import device_peaks, roofline_report
+
+    flops, bw = device_peaks("TPU v5 lite")
+    assert flops == 197e12 and bw == 819e9
+    # Unknown kinds (and None) fall back to (None, None), and the
+    # roofline report then omits the peak-relative fields instead of
+    # dividing by None.
+    assert device_peaks("Colossus MK1") == (None, None)
+    assert device_peaks(None) == (None, None)
+    rep = roofline_report({"flops_total": 1e9, "bytes_total": 1e6},
+                          seconds=0.5, device_kind="Colossus MK1")
+    assert rep["achieved_tflops"] == pytest.approx(2e-3)
+    assert "mfu_bf16_peak" not in rep and "roofline_bound" not in rep

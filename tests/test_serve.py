@@ -284,6 +284,67 @@ def test_as_requests_bridge_round_trip():
             r.x, np.asarray(batch_sol.x)[i, :6], atol=5e-4)
 
 
+def test_metrics_concurrent_hammer():
+    """The docstring claims every mutator takes the lock; exercise it:
+    hammer inc/observe_latency/observe_queue_wait/snapshot from threads
+    and assert exact counter totals and percentile sanity. Also pins
+    the reservoir-overwrite fix: the overwrite index follows the
+    reservoir's own observation counter, so a full reservoir keeps
+    rotating instead of clobbering one slot."""
+    import threading
+
+    metrics = ServeMetrics(latency_reservoir=64)
+    n_threads, n_iter = 8, 500
+    errors = []
+
+    def worker(k):
+        try:
+            for i in range(n_iter):
+                metrics.inc("submitted")
+                metrics.inc("completed", 2)
+                metrics.observe_latency(0.001 * (k + 1))
+                metrics.observe_queue_wait(0.002)
+                if i % 50 == 0:
+                    snap = metrics.snapshot()
+                    assert snap["submitted"] >= 0
+        except Exception as exc:  # noqa: BLE001 - surfaced below
+            errors.append(exc)
+
+    threads = [threading.Thread(target=worker, args=(k,))
+               for k in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    snap = metrics.snapshot()
+    total = n_threads * n_iter
+    assert snap["submitted"] == total
+    assert snap["completed"] == 2 * total
+    assert snap["queue_wait_seconds"] == pytest.approx(0.002 * total)
+    # Percentiles come from the bounded reservoir: every sample is one
+    # of the 8 per-thread values, and p50/p99 sit inside their range.
+    lo, hi = 0.001e3, 0.008e3  # ms
+    assert lo <= snap["latency_p50_ms"] <= hi
+    assert lo <= snap["latency_p99_ms"] <= hi
+    assert len(metrics._latencies) == 64
+
+
+def test_latency_reservoir_rotates_without_completed():
+    """Regression (reservoir overwrite bias): observe_latency used the
+    `completed` counter — incremented on a different code path — as its
+    overwrite index, so with completed frozen every overwrite hit slot
+    0. The reservoir now rotates on its own observation count."""
+    metrics = ServeMetrics(latency_reservoir=4)
+    for v in (1.0, 2.0, 3.0, 4.0):   # fill
+        metrics.observe_latency(v)
+    # completed stays 0 the whole time; overwrites must still rotate.
+    for v in (5.0, 6.0):
+        metrics.observe_latency(v)
+    assert sorted(metrics._latencies) == [3.0, 4.0, 5.0, 6.0]
+    assert metrics.counters["completed"] == 0
+
+
 def test_queue_backpressure_counts_rejections():
     from porqua_tpu.serve import QueueFull
 
