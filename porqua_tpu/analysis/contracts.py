@@ -43,6 +43,8 @@ __all__ = [
     "check_closed_jaxpr",
     "check_entry_points",
     "check_run_batch",
+    "compaction_step_jaxpr",
+    "continuous_jaxprs",
     "solve_batch_jaxpr",
     "serve_entry_jaxpr",
     "tracking_jaxpr",
@@ -178,6 +180,67 @@ def tracking_jaxpr(batch: int = 2, window: int = 8, n_assets: int = 6,
         lambda X, y: tracking_step(X, y, params))(Xs, ys)
 
 
+def compaction_step_jaxpr(batch: int = 6, group: int = 4,
+                          n: int = 16, m: int = 4,
+                          factor_rows: Optional[int] = None,
+                          params=None, dtype=np.float32) -> ClosedJaxpr:
+    """Trace the compaction driver's step-and-repack program exactly as
+    :class:`porqua_tpu.compaction.CompactingDriver` compiles it: one
+    segment over a ``group``-wide compacted lane set, the per-lane
+    freeze/select, the scatter-back into the ``batch``-wide result
+    buffer, and the stable active-first repack. GC102 on this program
+    is the machine-checked form of "the repack introduces no host
+    syncs or transfers"."""
+    from porqua_tpu.compaction import step_and_repack
+    from porqua_tpu.qp.solve import (
+        SolverParams, batch_shape_struct, prepare_batch)
+
+    params = SolverParams() if params is None else params
+    qp_s = batch_shape_struct(batch, n, m, dtype=dtype,
+                              factor_rows=factor_rows)
+    scaled_s, scaling_s, carry_s, _, _ = jax.eval_shape(
+        lambda q: prepare_batch(q, params), qp_s)
+    take = lambda t: jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct((group,) + s.shape[1:], s.dtype), t)
+    buf_s = carry_s.state
+    idx_s = jax.ShapeDtypeStruct((group,), np.int32)
+    segl_s = jax.ShapeDtypeStruct((group,), np.int32)
+    group_s = (take(scaled_s), take(scaling_s), take(carry_s),
+               None, None, idx_s, segl_s)
+    return jax.make_jaxpr(
+        lambda buf, grp: step_and_repack(buf, grp, params))(buf_s, group_s)
+
+
+def continuous_jaxprs(batch: int = 4, n: int = 16, m: int = 4,
+                      factor_rows: Optional[int] = None,
+                      params=None, dtype=np.float32):
+    """Trace the continuous-batching executable triple (admit / step /
+    finalize) — the same closures ``aot_compile_continuous`` lowers —
+    as ``[(label, ClosedJaxpr)]``."""
+    from porqua_tpu.qp.solve import (
+        SolverParams, batch_shape_struct, continuous_entries,
+        prepare_batch)
+
+    params = SolverParams() if params is None else params
+    qp_s = batch_shape_struct(batch, n, m, dtype=dtype,
+                              factor_rows=factor_rows)
+    x0_s = jax.ShapeDtypeStruct((batch, n), dtype)
+    y0_s = jax.ShapeDtypeStruct((batch, m), dtype)
+    mask_s = jax.ShapeDtypeStruct((batch,), np.bool_)
+    scaled_s, scaling_s, carry_s = jax.eval_shape(
+        lambda q, x, y: prepare_batch(q, params, x, y)[:3],
+        qp_s, x0_s, y0_s)
+    admit, step, fin = continuous_entries(params)
+    return [
+        ("continuous_admit", jax.make_jaxpr(admit)(
+            qp_s, x0_s, y0_s, mask_s, scaled_s, scaling_s, carry_s)),
+        ("continuous_step", jax.make_jaxpr(step)(
+            scaled_s, scaling_s, carry_s, mask_s)),
+        ("continuous_finalize", jax.make_jaxpr(fin)(
+            qp_s, scaled_s, scaling_s, carry_s.state)),
+    ]
+
+
 def run_batch_jaxpr(bs, params=None, dtype=np.float32) -> ClosedJaxpr:
     """Trace ``run_batch``'s device core against a *real*
     ``BacktestService``: the host pass (``build_problems``) runs for
@@ -237,4 +300,17 @@ def check_entry_points(dtype=np.float32,
         findings += check_closed_jaxpr(
             serve_entry_jaxpr(params=ring_params, dtype=dtype),
             "serve_entry[rings]", expect_float=dtype)
+    # Compaction / continuous-batching entry points: the segment step,
+    # the device-side repack + scatter-back, and the admit/finalize
+    # programs must stay free of host callbacks/transfers (GC102 here
+    # is the machine-checked form of "the repack introduces no host
+    # syncs") with stable dtypes across compacted widths.
+    findings += check_closed_jaxpr(
+        compaction_step_jaxpr(dtype=dtype), "compaction_step",
+        expect_float=dtype)
+    findings += check_closed_jaxpr(
+        compaction_step_jaxpr(factor_rows=factor_rows, dtype=dtype),
+        "compaction_step[factored]", expect_float=dtype)
+    for label, jaxpr in continuous_jaxprs(dtype=dtype):
+        findings += check_closed_jaxpr(jaxpr, label, expect_float=dtype)
     return findings

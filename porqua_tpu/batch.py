@@ -146,6 +146,39 @@ def solve_batch(problems: BatchProblems,
                               l1_center=problems.l1_center)
 
 
+def solve_batch_compacted(problems: BatchProblems,
+                          params: SolverParams = SolverParams(),
+                          segment_budget: Optional[int] = None,
+                          compact: bool = True,
+                          driver=None):
+    """Pass 2 with segment-level batch compaction: wall-clock tracks
+    total useful work instead of the slowest lane.
+
+    The segment loop runs on the host over the steppable solver API
+    (:mod:`porqua_tpu.compaction`): after every residual-check segment,
+    still-``RUNNING`` lanes are repacked to the front on device and the
+    dispatch width drops down the serving slot ladder, so converged
+    dates stop paying for stragglers. Converged lanes' solutions are
+    bit-identical to :func:`solve_batch`'s; a lane exceeding
+    ``segment_budget`` segments retires as ``MAX_ITER`` with the polish
+    fallback. Returns ``(QPSolution, CompactionReport)`` — the report
+    carries the executed-vs-dense lane-segment accounting ``bench.py``
+    pins the win with. Pass a shared ``driver``
+    (:class:`porqua_tpu.compaction.CompactingDriver`) to reuse compiled
+    executables across calls — its SolverParams must match ``params``
+    (a mismatch raises rather than silently solving at the driver's
+    tolerance); ``segment_budget`` is forwarded per call either way.
+    Sanitizer semantics match :func:`solve_batch` (the driver runs its
+    dispatch loop inside the transfer guard itself).
+    """
+    from porqua_tpu.compaction import solve_batch_compacted as _solve
+
+    return _solve(problems.qp, params, segment_budget=segment_budget,
+                  l1_weight=problems.l1_weight,
+                  l1_center=problems.l1_center,
+                  compact=compact, driver=driver)
+
+
 # Sentinel for scan-coupled entry points: the caller attests that every
 # date's problem was built over one identically-ordered asset universe
 # (e.g. synthetic batches built by construction). Use the real per-date

@@ -1,0 +1,475 @@
+"""Segment-level batch compaction: straggler-free batched solving.
+
+``vmap(admm_solve)`` runs one ``lax.while_loop`` for the whole batch,
+so every lane pays for the slowest: the round-2 regime measured
+straggler lanes charging extra segments to the whole batch (3.7 s vs
+95 ms, qp/admm.py), and 26/252 north-star dates hitting ``max_iter``
+in a measured config. First-order QP batching on accelerators
+(OSQP-GPU, arXiv:1912.04263) and restarted first-order methods with
+highly variable per-problem iteration counts (PDQP, arXiv:2311.07710)
+both find wall-clock tracks the iteration *distribution*, not its
+median — so the fix is to retire converged work early.
+
+This driver hoists the segment loop to the host, using the steppable
+solver API (:func:`porqua_tpu.qp.solve.prepare_batch` /
+``segment_step_batch`` / ``finalize_batch``):
+
+1. run one residual-check segment for the current lane group;
+2. **repack on device** — already-retired lanes are frozen via select
+   (exactly the vmapped while_loop's semantics), the still-``RUNNING``
+   lanes are stably sorted to the front, and their final states are
+   scattered into a full-batch result buffer at their original lane
+   index (order preservation is by construction);
+3. read back ONE scalar (the active-lane count — the only host sync
+   per boundary), and slice the group down the serving slot ladder
+   (:func:`porqua_tpu.serve.bucketing.slot_ladder`) so every compacted
+   shape is one of ~log2(B) pre-compiled executables — zero
+   steady-state recompiles by construction (``prewarm`` compiles the
+   whole ladder ahead of measurement);
+4. when no lane is left running, one full-batch ``finalize`` pass
+   polishes, unscales, and grades every lane in original order.
+
+Per-lane arithmetic is the exact code the fused path runs, so lanes
+that converge produce **bit-identical** solutions to the
+non-compacting ``solve_qp_batch`` (pinned by tests/test_compaction.py).
+A per-lane ``segment_budget`` retires stragglers to ``MAX_ITER`` +
+the polish fallback instead of taxing cohort latency.
+
+Under ``PORQUA_SANITIZE=1`` the whole dispatch loop runs inside
+``jax.transfer_guard("disallow")``: the repack/scatter programs are
+pure device work (proved callback/transfer-free by the GC101–103
+jaxpr contracts, ``analysis/contracts.py``), and the per-boundary
+active-count readout is an explicit ``jax.device_get``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from porqua_tpu.analysis import sanitize
+from porqua_tpu.qp.admm import Status
+from porqua_tpu.qp.canonical import CanonicalQP
+from porqua_tpu.qp.solve import (
+    QPSolution,
+    SolverParams,
+    batch_shape_struct,
+    default_segment_budget,
+    finalize_batch,
+    prepare_batch,
+    segment_step_batch,
+    select_lanes,
+)
+from porqua_tpu.serve.bucketing import slot_ladder
+
+__all__ = [
+    "CompactingDriver",
+    "CompactionReport",
+    "iter_segments",
+    "lane_active",
+    "step_and_repack",
+    "solve_batch_compacted",
+]
+
+
+def iter_segments(iters, check_interval: int):
+    """Per-lane executed segments from recorded iteration counts.
+
+    ``state.iters`` advances by exactly ``check_interval`` per segment,
+    so ceil and floor currently agree — this single definition is what
+    keeps the driver's :class:`CompactionReport` and ``bench.py``'s
+    ``_iteration_distribution`` from silently forking if a future
+    change ever records partial-segment counts."""
+    it = np.asarray(iters, dtype=np.int64)
+    return np.maximum(-(-it // int(check_interval)), 1)
+
+
+def lane_active(state, seg_left, params: SolverParams):
+    """Which lanes still step: ``RUNNING``, inside the fused path's
+    iteration budget, AND inside the driver's per-lane segment budget
+    (``seg_left`` counts segments remaining; at the default budget
+    ``ceil(max_iter / check_interval)`` the last two are equivalent,
+    so compaction-off semantics match ``solve_qp_batch`` exactly)."""
+    return ((state.status == Status.RUNNING)
+            & (state.iters < params.max_iter)
+            & (seg_left > 0))
+
+
+def step_and_repack(buf, group, params: SolverParams):
+    """One compacted segment + the device-side repack (pure — traced
+    by the GC101–103 contracts to prove no host syncs/transfers).
+
+    ``buf`` is the full-batch :class:`~porqua_tpu.qp.admm.ADMMState`
+    result buffer; ``group`` is the compacted working set
+    ``(scaled, scaling, carry, l1w_s, l1c_s, idx, seg_left)`` where
+    ``idx`` maps compacted position -> original lane. Returns
+    ``(buf', group', n_active)`` with the still-active lanes stably
+    sorted to the front of ``group'`` (the host slices it down the
+    slot ladder after reading ``n_active`` — the one scalar readout
+    per boundary).
+    """
+    scaled, scaling, carry, l1w_s, l1c_s, idx, seg_left = group
+    active_in = lane_active(carry.state, seg_left, params)
+    stepped = segment_step_batch(scaled, scaling, carry, params,
+                                 l1w_s, l1c_s)
+    # Freeze lanes that were already retired (ladder-padding slots):
+    # identical to the vmapped while_loop's per-lane select, so a
+    # retired lane's state can never advance past its retirement.
+    carry = select_lanes(active_in, stepped, carry)
+    seg_left = jnp.where(active_in, seg_left - 1, seg_left)
+    # Scatter-back at the original lane order. Frozen lanes rewrite
+    # their unchanged state — harmless, and it keeps this a single
+    # unconditional program.
+    buf = jax.tree.map(lambda f, v: f.at[idx].set(v), buf, carry.state)
+    active = lane_active(carry.state, seg_left, params)
+    order = jnp.argsort(jnp.logical_not(active), stable=True)
+    group = jax.tree.map(
+        lambda a: a[order],
+        (scaled, scaling, carry, l1w_s, l1c_s, idx, seg_left))
+    return buf, group, jnp.sum(active).astype(jnp.int32)
+
+
+@dataclasses.dataclass(frozen=True)
+class CompactionReport:
+    """Work accounting for one compacted solve (the A/B evidence)."""
+
+    batch: int
+    segments: int                  # boundaries executed (dispatch count)
+    lane_segments: int             # sum of dispatch sizes — work executed
+    dense_lane_segments: int       # batch * max per-lane segments (the
+    #                                fused while_loop's cost)
+    useful_lane_segments: int      # sum of per-lane segments needed
+    wasted_fraction_dense: float   # 1 - useful/dense: the straggler tax
+    #                                with compaction OFF
+    wasted_fraction: float         # 1 - useful/executed: residual
+    #                                ladder-padding waste with it ON
+    dispatch_sizes: Tuple[int, ...]
+    compiles: int                  # executables built during this solve
+    #                                (0 once prewarmed — the recompile
+    #                                contract)
+    max_iter_lanes: int            # lanes graded MAX_ITER post-polish
+
+    @property
+    def savings_vs_dense(self) -> float:
+        """Fraction of the fused path's lane-segments NOT executed."""
+        if not self.dense_lane_segments:
+            return 0.0
+        return 1.0 - self.lane_segments / self.dense_lane_segments
+
+
+class CompactingDriver:
+    """Host orchestration + AOT executable cache for compacted solves.
+
+    One driver holds one :class:`SolverParams` (it is part of every
+    executable's identity) and caches three executable kinds per batch
+    shape: ``init`` (equilibrate + carry build, full batch), ``step``
+    (one segment + repack, one per slot-ladder rung), and ``finalize``
+    (polish + unscale + grade, full batch). ``prewarm`` compiles the
+    whole ladder so a measured solve performs zero compiles; compiles
+    are also reported to :mod:`porqua_tpu.analysis.sanitize` (a
+    post-prewarm compile raises under ``PORQUA_SANITIZE=1``).
+    """
+
+    def __init__(self,
+                 params: SolverParams = SolverParams(),
+                 segment_budget: Optional[int] = None,
+                 min_dispatch: int = 2,
+                 device=None) -> None:
+        self.params = params
+        if segment_budget is not None and segment_budget < 1:
+            raise ValueError("segment_budget must be >= 1")
+        self.segment_budget = int(segment_budget
+                                  or default_segment_budget(params))
+        # Never compact below this width (clamped to the batch size).
+        # Width 1 is excluded by default: XLA rewrites batch-1 batched
+        # matmuls into plain dots with a different accumulation order,
+        # which breaks bit-parity with the fused while_loop for lanes
+        # that step at width 1 (measured ~1e-7 drift on CPU); width >= 2
+        # keeps the batched lowering and measured bit-exactness.
+        self.min_dispatch = max(1, int(min_dispatch))
+        self.device = device
+        self._lock = threading.Lock()
+        self._cache: dict = {}          # guarded-by: self._lock
+        self.compiles = 0               # guarded-by: self._lock
+        self._sealed = False            # guarded-by: self._lock
+
+    # -- executable construction -------------------------------------
+
+    def _shape_key(self, B: int, n: int, m: int, factor_rows,
+                   dtype, has_warm: bool, has_l1: bool) -> tuple:
+        # The segment budget is a runtime input (a scalar operand of
+        # the init program), NOT part of the executable identity — one
+        # compiled ladder serves every budget.
+        return (B, n, m, factor_rows, np.dtype(dtype).str,
+                bool(has_warm), bool(has_l1))
+
+    def _get(self, key: tuple, build):
+        with self._lock:
+            exe = self._cache.get(key)
+            if exe is not None:
+                return exe
+            sealed = self._sealed
+        # Compile outside the lock is unnecessary here (single host
+        # loop drives a solve), but note the demand first so a refused
+        # post-warmup compile under PORQUA_SANITIZE=1 never half-fills
+        # the cache.
+        sanitize.note_compile(f"compaction {key[0] if key else ''}"
+                              f" {key}", post_warmup=sealed)
+        with (jax.default_device(self.device) if self.device is not None
+              else _null()):
+            exe = build()
+        with self._lock:
+            self._cache[key] = exe
+            self.compiles += 1
+        return exe
+
+    def _init_entry(self, has_warm: bool, has_l1: bool):
+        params = self.params
+
+        def entry(qp, budget, *extra):
+            i = 0
+            x0 = y0 = l1w = l1c = None
+            if has_warm:
+                x0, y0 = extra[i], extra[i + 1]
+                i += 2
+            if has_l1:
+                l1w, l1c = extra[i], extra[i + 1]
+            scaled, scaling, carry, l1w_s, l1c_s = prepare_batch(
+                qp, params, x0, y0, l1w, l1c)
+            B = qp.q.shape[0]
+            idx = jnp.arange(B, dtype=jnp.int32)
+            seg_left = jnp.full((B,), budget, jnp.int32)
+            return scaled, scaling, carry, l1w_s, l1c_s, idx, seg_left
+
+        return entry
+
+    def _structs(self, B, n, m, factor_rows, dtype, has_warm, has_l1):
+        qp_s = batch_shape_struct(B, n, m, dtype=dtype,
+                                  factor_rows=factor_rows)
+        budget_s = jax.ShapeDtypeStruct((), np.int32)
+        extra = ()
+        if has_warm:
+            extra += (jax.ShapeDtypeStruct((B, n), dtype),
+                      jax.ShapeDtypeStruct((B, m), dtype))
+        if has_l1:
+            extra += (jax.ShapeDtypeStruct((B, n), dtype),
+                      jax.ShapeDtypeStruct((B, n), dtype))
+        group_s = jax.eval_shape(self._init_entry(has_warm, has_l1),
+                                 qp_s, budget_s, *extra)
+        return qp_s, (budget_s,) + extra, group_s
+
+    def _exe_init(self, skey):
+        B, n, m, fr, dts, has_warm, has_l1 = skey
+        dtype = np.dtype(dts)
+
+        def build():
+            qp_s, extra, _ = self._structs(B, n, m, fr, dtype,
+                                           has_warm, has_l1)
+            entry = self._init_entry(has_warm, has_l1)
+            return jax.jit(entry).lower(qp_s, *extra).compile()
+
+        return self._get(("init",) + skey, build)
+
+    def _exe_step(self, skey, b: int):
+        B, n, m, fr, dts, has_warm, has_l1 = skey
+        dtype = np.dtype(dts)
+        params = self.params
+
+        def build():
+            _, _, group_s = self._structs(B, n, m, fr, dtype,
+                                          has_warm, has_l1)
+            buf_s = group_s[2].state
+            take = jax.tree.map(
+                lambda t: jax.ShapeDtypeStruct((b,) + t.shape[1:],
+                                               t.dtype), group_s)
+
+            def entry(buf, group):
+                return step_and_repack(buf, group, params)
+
+            return jax.jit(entry).lower(buf_s, take).compile()
+
+        return self._get(("step", b) + skey, build)
+
+    def _exe_finalize(self, skey):
+        B, n, m, fr, dts, has_warm, has_l1 = skey
+        dtype = np.dtype(dts)
+        params = self.params
+
+        def build():
+            qp_s, _, group_s = self._structs(B, n, m, fr, dtype,
+                                             has_warm, has_l1)
+            scaled_s, scaling_s = group_s[0], group_s[1]
+            buf_s = group_s[2].state
+            l1_s = ()
+            if has_l1:
+                v = jax.ShapeDtypeStruct((B, n), dtype)
+                l1_s = (v, v, group_s[3], group_s[4])
+
+            def entry(qp, scaled, scaling, state, *l1):
+                lw = lc = lws = lcs = None
+                if l1:
+                    lw, lc, lws, lcs = l1
+                return finalize_batch(qp, scaled, scaling, state, params,
+                                      lw, lc, lws, lcs)
+
+            return jax.jit(entry).lower(
+                qp_s, scaled_s, scaling_s, buf_s, *l1_s).compile()
+
+        return self._get(("finalize",) + skey, build)
+
+    # -- public API ---------------------------------------------------
+
+    def prewarm(self, batch: int, n: int, m: int,
+                dtype=np.float32, factor_rows: Optional[int] = None,
+                has_warm: bool = False, has_l1: bool = False) -> int:
+        """Compile init + finalize + every slot-ladder step executable
+        for one batch shape; returns the number compiled. Afterward a
+        solve at this shape performs zero compiles, and any further
+        compile demand raises under ``PORQUA_SANITIZE=1``."""
+        skey = self._shape_key(batch, n, m, factor_rows, dtype,
+                               has_warm, has_l1)
+        with self._lock:
+            before = self.compiles
+            self._sealed = False
+        self._exe_init(skey)
+        for b in slot_ladder(batch):
+            self._exe_step(skey, b)
+        self._exe_finalize(skey)
+        with self._lock:
+            self._sealed = True
+            return self.compiles - before
+
+    def solve(self, qp: CanonicalQP,
+              x0: Optional[jax.Array] = None,
+              y0: Optional[jax.Array] = None,
+              l1_weight: Optional[jax.Array] = None,
+              l1_center: Optional[jax.Array] = None,
+              compact: bool = True,
+              segment_budget: Optional[int] = None):
+        """Solve a stacked batch; returns ``(QPSolution,
+        CompactionReport)``. ``compact=False`` runs the identical
+        segment-stepped loop at full batch width every boundary — the
+        A/B control ``bench.py`` measures against. ``segment_budget``
+        overrides the driver default for this call (a runtime operand —
+        no recompile)."""
+        if (x0 is None) != (y0 is None):
+            raise ValueError("x0 and y0 must be given together")
+        if (l1_weight is None) != (l1_center is None):
+            raise ValueError("l1_weight and l1_center must be given "
+                             "together")
+        if segment_budget is not None and segment_budget < 1:
+            raise ValueError("segment_budget must be >= 1")
+        budget = int(segment_budget or self.segment_budget)
+        B, n, m = int(qp.q.shape[0]), qp.n, qp.m
+        fr = None if qp.Pf is None else int(np.shape(qp.Pf)[-2])
+        dtype = np.dtype(qp.q.dtype)
+        has_warm = x0 is not None
+        has_l1 = l1_weight is not None
+        skey = self._shape_key(B, n, m, fr, dtype, has_warm, has_l1)
+        with self._lock:
+            compiles0 = self.compiles
+        ladder = slot_ladder(B)
+
+        # The budget scalar is placed explicitly (ours, host-born) so
+        # the sanitizer's transfer guard below only polices *implicit*
+        # traffic; under PORQUA_SANITIZE=1 callers pass device-resident
+        # problem data, matching batch.solve_batch's contract.
+        extra = (jax.device_put(np.asarray(budget, np.int32),
+                                self.device),)
+        if has_warm:
+            extra += (x0, y0)
+        if has_l1:
+            extra += (l1_weight, l1_center)
+
+        sizes: List[int] = []
+        with sanitize.transfer_guard():
+            out = self._exe_init(skey)(qp, *extra)
+            scaled, scaling, carry, l1w_s, l1c_s, idx, seg_left = out
+            # Full-batch references for the finalize pass (the group
+            # below gets compacted; these stay at B, in lane order).
+            scaled_full, scaling_full = scaled, scaling
+            l1ws_full, l1cs_full = l1w_s, l1c_s
+            buf = carry.state
+            group = (scaled, scaling, carry, l1w_s, l1c_s, idx, seg_left)
+            b = B
+            while True:
+                buf, group, n_active = self._exe_step(skey, b)(buf, group)
+                sizes.append(b)
+                # The one host sync per segment boundary: an explicit
+                # scalar fetch (transfer-guard-legal) deciding the next
+                # dispatch shape.
+                n_act = int(jax.device_get(n_active))
+                if n_act == 0:
+                    break
+                if compact:
+                    floor = min(self.min_dispatch, B)
+                    b_next = next(s for s in ladder
+                                  if s >= max(n_act, floor))
+                    if b_next < b:
+                        group = jax.tree.map(lambda a: a[:b_next], group)
+                        b = b_next
+            l1_args = ((l1_weight, l1_center, l1ws_full, l1cs_full)
+                       if has_l1 else ())
+            sol = self._exe_finalize(skey)(qp, scaled_full, scaling_full,
+                                           buf, *l1_args)
+
+        iters = np.asarray(jax.device_get(sol.iters))
+        status = np.asarray(jax.device_get(sol.status))
+        segs = iter_segments(iters, self.params.check_interval)
+        useful = int(segs.sum())
+        dense = int(B * segs.max())
+        executed = int(sum(sizes))
+        with self._lock:
+            compiled = self.compiles - compiles0
+        report = CompactionReport(
+            batch=B,
+            segments=len(sizes),
+            lane_segments=executed,
+            dense_lane_segments=dense,
+            useful_lane_segments=useful,
+            wasted_fraction_dense=(1.0 - useful / dense) if dense else 0.0,
+            wasted_fraction=(1.0 - useful / executed) if executed else 0.0,
+            dispatch_sizes=tuple(sizes),
+            compiles=compiled,
+            max_iter_lanes=int(np.sum(status == Status.MAX_ITER)),
+        )
+        return sol, report
+
+
+def _null():
+    import contextlib
+
+    return contextlib.nullcontext()
+
+
+def solve_batch_compacted(qp: CanonicalQP,
+                          params: SolverParams = SolverParams(),
+                          segment_budget: Optional[int] = None,
+                          x0=None, y0=None,
+                          l1_weight=None, l1_center=None,
+                          compact: bool = True,
+                          driver: Optional[CompactingDriver] = None):
+    """One-shot convenience over :class:`CompactingDriver`; returns
+    ``(QPSolution, CompactionReport)``. Pass a ``driver`` to reuse its
+    executable cache across calls (the bench A/B does) — its
+    SolverParams must match ``params`` (executables are compiled
+    against them; silently solving at the driver's params instead
+    would hand back results at the wrong tolerance). The
+    ``segment_budget`` is forwarded per call either way (a runtime
+    operand, no recompile)."""
+    if driver is None:
+        driver = CompactingDriver(params, segment_budget=segment_budget)
+    elif driver.params != params:
+        raise ValueError(
+            "the shared driver was built for different SolverParams "
+            "than this call requests; construct a CompactingDriver "
+            "with these params (or omit driver)")
+    return driver.solve(qp, x0=x0, y0=y0, l1_weight=l1_weight,
+                        l1_center=l1_center, compact=compact,
+                        segment_budget=segment_budget)

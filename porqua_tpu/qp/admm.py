@@ -228,6 +228,26 @@ class ADMMState(NamedTuple):
     ring_rho: Optional[jax.Array] = None   # (ring_size,)
 
 
+class ADMMCarry(NamedTuple):
+    """The segment-loop carry: solver state plus the Halpern anchor.
+
+    This is exactly what :func:`admm_solve`'s ``lax.while_loop``
+    carries between segments — exposed so batch orchestration
+    (compaction, continuous serving) can hoist the loop *above* the
+    device program: ``admm_init`` builds it, ``admm_segment_step``
+    advances it one residual-check segment, and ``admm_solve`` is the
+    thin while_loop over the two.
+    """
+
+    state: ADMMState
+    # Halpern anchor point (x, z, w, y, mu); carried unconditionally
+    # (five vector copies) so the carry structure does not fork on
+    # params.halpern and one compacted executable serves both.
+    anchor: tuple
+    k_anchor: jax.Array    # () int32, iterations since the last restart
+    res_anchor: jax.Array  # () scaled residual at the last restart
+
+
 def _inf_norm(v):
     return jnp.max(jnp.abs(v)) if v.size else jnp.asarray(0.0, v.dtype)
 
@@ -525,36 +545,20 @@ def blocked_triangular_inverse(L: jax.Array,
     return out
 
 
-def admm_solve(qp: CanonicalQP,
-               scaling: Scaling,
-               params: SolverParams,
-               x0: Optional[jax.Array] = None,
-               y0: Optional[jax.Array] = None,
-               l1_weight: Optional[jax.Array] = None,
-               l1_center: Optional[jax.Array] = None) -> ADMMState:
-    """Run the ADMM loop on one *scaled* problem. Returns the final state.
+def admm_init(qp: CanonicalQP,
+              params: SolverParams,
+              x0: Optional[jax.Array] = None,
+              y0: Optional[jax.Array] = None) -> ADMMCarry:
+    """Build the segment-loop carry for one *scaled* problem.
 
-    ``x0``/``y0`` warm starts are in the scaled frame (callers go through
-    :func:`porqua_tpu.qp.solve.solve_qp`, which handles scaling).
-
-    ``l1_weight``/``l1_center`` (scaled frame, per-variable) add a
-    nonsmooth objective term sum_i l1_weight_i * |x_i - l1_center_i|
-    handled *natively* by the w-block prox — the box projection becomes
-    a clipped shifted soft-threshold (in 1-D,
-    ``prox_{I_[lb,ub] + lam|.-c|} = clip(c + soft(v - c, lam))`` since a
-    convex 1-D objective restricted to an interval attains its minimum
-    at the projection of the unconstrained minimizer). This is the
-    static-shape TPU alternative to the reference's dimension-expanding
-    turnover-cost linearization (reference ``qp_problems.py:120-157``,
-    mirrored by :func:`porqua_tpu.qp.lift.lift_turnover_objective`).
+    ``x0``/``y0`` warm starts are in the scaled frame. The returned
+    carry is advanced by :func:`admm_segment_step`; :func:`admm_solve`
+    is exactly a ``lax.while_loop`` of that step over this value, so a
+    driver that reads per-lane status at each boundary (and repacks or
+    retires lanes) runs the identical per-lane program.
     """
     dtype = qp.P.dtype
     n, m = qp.n, qp.m
-    sigma = jnp.asarray(params.sigma, dtype)
-    alpha = jnp.asarray(params.alpha, dtype)
-    l1w = jnp.zeros(n, dtype) if l1_weight is None else l1_weight
-    l1c = jnp.zeros(n, dtype) if l1_center is None else l1_center
-
     x_init = jnp.zeros(n, dtype) if x0 is None else x0
     y_init = jnp.zeros(m, dtype) if y0 is None else y0
     z_init = jnp.dot(qp.C, x_init, precision=_HP)
@@ -577,25 +581,34 @@ def admm_solve(qp: CanonicalQP,
         if ring_size else None,
         ring_rho=jnp.zeros((ring_size,), dtype) if ring_size else None,
     )
+    return ADMMCarry(
+        state=init,
+        anchor=(init.x, init.z, init.w, init.y, init.mu),
+        k_anchor=jnp.asarray(0, jnp.int32),
+        res_anchor=jnp.asarray(jnp.inf, dtype),
+    )
 
-    def one_iteration(carry, solve, rho, rho_b):
-        x, z, w, y, mu = carry
-        rhs = (sigma * x - qp.q + jnp.dot(rho * z - y, qp.C, precision=_HP)
-               + (rho_b * w - mu))
-        xt = solve(rhs)
-        zt = jnp.dot(qp.C, xt, precision=_HP)
 
-        x_new = alpha * xt + (1 - alpha) * x
+class _SegmentPlan(NamedTuple):
+    """Static (host-side) decisions for one segment program: the
+    resolved linear-solve mode, the Pallas opt-in, and the f32
+    adaptive-rho clamp. Derived only from params + problem *structure*
+    (shapes/dtypes), so it is identical under jit/vmap tracing."""
 
-        z_arg = alpha * zt + (1 - alpha) * z + y / rho
-        z_new = jnp.clip(z_arg, qp.l, qp.u)
-        y_new = y + rho * (alpha * zt + (1 - alpha) * z - z_new)
+    linsolve: str
+    use_pallas: bool
+    rho_lo: float
+    rho_hi: float
 
-        w_arg = alpha * xt + (1 - alpha) * w + mu / rho_b
-        w_new = l1_box_prox(w_arg, qp.lb, qp.ub, l1w / rho_b, l1c)
-        mu_new = mu + rho_b * (alpha * xt + (1 - alpha) * w - w_new)
-        return (x_new, z_new, w_new, y_new, mu_new)
 
+def _segment_plan(qp: CanonicalQP, params: SolverParams,
+                  warn: bool = False) -> _SegmentPlan:
+    """Resolve the backend/linsolve/clamp decisions ``admm_solve`` has
+    always made up front. ``warn=False`` (the steppable API) keeps the
+    resolution silent — per-segment callers would otherwise emit the
+    same warning every boundary."""
+    dtype = qp.P.dtype
+    n, m = qp.n, qp.m
     # Estimated VMEM footprint of the fused segment. Dense forms hold
     # the explicit KKT inverse (n x n) + the constraint matrix (m x n);
     # the factored (woodbury) form holds the capacitance pieces
@@ -630,26 +643,26 @@ def admm_solve(qp: CanonicalQP,
     # count. (Its non-trinv mode also carries the explicit-f32-K^-1
     # accuracy penalty: measured 100 vs 25 iterations.)
     use_pallas = params.backend == "pallas" and not params.halpern
-    if params.backend == "pallas" and params.halpern:
+    if warn and params.backend == "pallas" and params.halpern:
         warnings.warn(
             "backend='pallas' does not implement Halpern anchoring; "
             "running the XLA segment instead (halpern=False restores "
-            "the fused kernel)", stacklevel=2)
-    if use_pallas:
+            "the fused kernel)", stacklevel=3)
+    if warn and use_pallas:
         if not fits_vmem:
             warnings.warn(
                 f"backend='pallas' requested but the estimated VMEM footprint "
                 f"({vmem_bytes / 2**20:.1f} MB for n={n}, m={m}) exceeds "
                 f"vmem_limit_mb={params.vmem_limit_mb}; the kernel may fail "
                 f"to compile or spill. backend='auto' would use the XLA path.",
-                stacklevel=2,
+                stacklevel=3,
             )
         if jax.default_backend() != "tpu":
             warnings.warn(
                 "backend='pallas' on a non-TPU host runs the kernel in "
                 "interpret mode (orders of magnitude slower than the XLA "
                 "path); use backend='auto' unless this is a parity test.",
-                stacklevel=2,
+                stacklevel=3,
             )
     use_inverse = use_pallas or linsolve in ("inverse", "trinv", "woodbury")
 
@@ -668,8 +681,8 @@ def admm_solve(qp: CanonicalQP,
         defaults = SolverParams()
         caller_tuned = (params.rho_min != defaults.rho_min
                         or params.rho_max != defaults.rho_max)
-        if caller_tuned and (rho_lo != params.rho_min
-                             or rho_hi != params.rho_max):
+        if warn and caller_tuned and (rho_lo != params.rho_min
+                                      or rho_hi != params.rho_max):
             warnings.warn(
                 f"f32 inverse-based linear solve narrows the adaptive-rho "
                 f"clamp from [{params.rho_min:g}, {params.rho_max:g}] to "
@@ -677,10 +690,54 @@ def admm_solve(qp: CanonicalQP,
                 f"the refined f32 inverse can represent); set "
                 f"linsolve='chol' and backend='xla' to keep the requested "
                 f"bounds.",
-                stacklevel=2,
+                stacklevel=3,
             )
     else:
         rho_lo, rho_hi = params.rho_min, params.rho_max
+    return _SegmentPlan(linsolve=linsolve, use_pallas=use_pallas,
+                        rho_lo=rho_lo, rho_hi=rho_hi)
+
+
+def _make_segment(qp: CanonicalQP,
+                  scaling: Scaling,
+                  params: SolverParams,
+                  l1w: jax.Array,
+                  l1c: jax.Array,
+                  plan: _SegmentPlan,
+                  track_l1: bool):
+    """Build the one-segment transition ``ADMMCarry -> ADMMCarry``:
+    ``check_interval`` iterations (with the Cholesky/capacitance
+    factorization amortized across them), one residual check, the
+    status/adaptive-rho/ring/Halpern updates. Shared verbatim by
+    :func:`admm_solve`'s while_loop and :func:`admm_segment_step`, so
+    the hoisted loop cannot drift from the fused one. ``track_l1``
+    marks a live native L1 term (the dual-infeasibility certificate
+    must include its slope)."""
+    dtype = qp.P.dtype
+    n = qp.n
+    sigma = jnp.asarray(params.sigma, dtype)
+    alpha = jnp.asarray(params.alpha, dtype)
+    linsolve, use_pallas = plan.linsolve, plan.use_pallas
+    rho_lo, rho_hi = plan.rho_lo, plan.rho_hi
+    ring_size = params.ring_size
+
+    def one_iteration(carry, solve, rho, rho_b):
+        x, z, w, y, mu = carry
+        rhs = (sigma * x - qp.q + jnp.dot(rho * z - y, qp.C, precision=_HP)
+               + (rho_b * w - mu))
+        xt = solve(rhs)
+        zt = jnp.dot(qp.C, xt, precision=_HP)
+
+        x_new = alpha * xt + (1 - alpha) * x
+
+        z_arg = alpha * zt + (1 - alpha) * z + y / rho
+        z_new = jnp.clip(z_arg, qp.l, qp.u)
+        y_new = y + rho * (alpha * zt + (1 - alpha) * z - z_new)
+
+        w_arg = alpha * xt + (1 - alpha) * w + mu / rho_b
+        w_new = l1_box_prox(w_arg, qp.lb, qp.ub, l1w / rho_b, l1c)
+        mu_new = mu + rho_b * (alpha * xt + (1 - alpha) * w - w_new)
+        return (x_new, z_new, w_new, y_new, mu_new)
 
     def refined_inverse(K, chol):
         """Explicit K^-1 with one Newton step: Kinv <- Kinv (2I - K Kinv).
@@ -707,7 +764,7 @@ def admm_solve(qp: CanonicalQP,
         by TestTriangularKernel)."""
         return blocked_triangular_inverse(jnp.linalg.cholesky(K))
 
-    def segment(loop_carry):
+    def segment(loop_carry: ADMMCarry) -> ADMMCarry:
         state, anchor, k_anchor, res_anchor = loop_carry
         rho, rho_b = _rho_vectors(qp, state.rho_bar, params)
         if params.rho_l1_scale != 1.0:
@@ -859,7 +916,7 @@ def admm_solve(qp: CanonicalQP,
         solved = (r_prim <= eps_p) & (r_dual <= eps_d)
         p_inf, d_inf, _ = _infeasibility(
             qp, scaling, dx, dy, dmu, params,
-            l1w=None if l1_weight is None else l1w,
+            l1w=l1w if track_l1 else None,
         )
 
         status = jnp.where(
@@ -923,19 +980,85 @@ def admm_solve(qp: CanonicalQP,
                            for c, a in zip(cur, anchor))
             k_anchor = jnp.where(restart, 0, k_new).astype(jnp.int32)
             res_anchor = jnp.where(restart, res_now, res_anchor)
-        return (new_state, anchor, k_anchor, res_anchor)
+        return ADMMCarry(state=new_state, anchor=anchor,
+                         k_anchor=k_anchor, res_anchor=res_anchor)
 
-    def cond(loop_carry):
-        state = loop_carry[0]
+    return segment
+
+
+def admm_segment_step(carry: ADMMCarry,
+                      qp: CanonicalQP,
+                      scaling: Scaling,
+                      params: SolverParams,
+                      l1_weight: Optional[jax.Array] = None,
+                      l1_center: Optional[jax.Array] = None):
+    """Advance one residual-check segment; returns ``(carry,
+    per_lane_status)``.
+
+    The steppable half of :func:`admm_solve`: ``check_interval``
+    iterations, one on-device residual/infeasibility check, the
+    adaptive-rho / convergence-ring / Halpern-restart updates. The
+    returned status is ``carry.state.status`` (a :class:`Status` code,
+    per lane once vmapped) so batch orchestration living *above* the
+    loop — compaction, continuous batching — can retire converged
+    lanes at segment boundaries. Note the step itself never flips
+    ``RUNNING`` to ``MAX_ITER``: the iteration budget is the
+    orchestrator's policy (``admm_solve`` applies it after its
+    while_loop; drivers apply a per-lane segment budget instead).
+    """
+    dtype = qp.P.dtype
+    n = qp.n
+    l1w = jnp.zeros(n, dtype) if l1_weight is None else l1_weight
+    l1c = jnp.zeros(n, dtype) if l1_center is None else l1_center
+    plan = _segment_plan(qp, params, warn=False)
+    segment = _make_segment(qp, scaling, params, l1w, l1c, plan,
+                            track_l1=l1_weight is not None)
+    new = segment(carry)
+    return new, new.state.status
+
+
+def admm_solve(qp: CanonicalQP,
+               scaling: Scaling,
+               params: SolverParams,
+               x0: Optional[jax.Array] = None,
+               y0: Optional[jax.Array] = None,
+               l1_weight: Optional[jax.Array] = None,
+               l1_center: Optional[jax.Array] = None) -> ADMMState:
+    """Run the ADMM loop on one *scaled* problem. Returns the final state.
+
+    ``x0``/``y0`` warm starts are in the scaled frame (callers go through
+    :func:`porqua_tpu.qp.solve.solve_qp`, which handles scaling).
+
+    ``l1_weight``/``l1_center`` (scaled frame, per-variable) add a
+    nonsmooth objective term sum_i l1_weight_i * |x_i - l1_center_i|
+    handled *natively* by the w-block prox — the box projection becomes
+    a clipped shifted soft-threshold (in 1-D,
+    ``prox_{I_[lb,ub] + lam|.-c|} = clip(c + soft(v - c, lam))`` since a
+    convex 1-D objective restricted to an interval attains its minimum
+    at the projection of the unconstrained minimizer). This is the
+    static-shape TPU alternative to the reference's dimension-expanding
+    turnover-cost linearization (reference ``qp_problems.py:120-157``,
+    mirrored by :func:`porqua_tpu.qp.lift.lift_turnover_objective`).
+
+    Structurally this is now a thin ``lax.while_loop`` over the
+    steppable API (:func:`admm_init` + the segment transition
+    :func:`admm_segment_step` advances), so batch drivers that hoist
+    the loop to the host run the identical per-lane program.
+    """
+    dtype = qp.P.dtype
+    n = qp.n
+    l1w = jnp.zeros(n, dtype) if l1_weight is None else l1_weight
+    l1c = jnp.zeros(n, dtype) if l1_center is None else l1_center
+    plan = _segment_plan(qp, params, warn=True)
+    segment = _make_segment(qp, scaling, params, l1w, l1c, plan,
+                            track_l1=l1_weight is not None)
+
+    def cond(loop_carry: ADMMCarry):
+        state = loop_carry.state
         return (state.status == Status.RUNNING) & (state.iters < params.max_iter)
 
-    init_carry = (
-        init,
-        (init.x, init.z, init.w, init.y, init.mu),
-        jnp.asarray(0, jnp.int32),
-        jnp.asarray(jnp.inf, dtype),
-    )
-    final = jax.lax.while_loop(cond, segment, init_carry)[0]
+    init_carry = admm_init(qp, params, x0, y0)
+    final = jax.lax.while_loop(cond, segment, init_carry).state
     final = final._replace(
         status=jnp.where(
             final.status == Status.RUNNING, Status.MAX_ITER, final.status

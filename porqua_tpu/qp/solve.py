@@ -19,9 +19,12 @@ import jax
 import jax.numpy as jnp
 
 from porqua_tpu.qp.admm import (
+    ADMMCarry,
     ADMMState,
     SolverParams,
     Status,
+    admm_init,
+    admm_segment_step,
     admm_solve,
     _residuals,
     _support,
@@ -63,12 +66,17 @@ class QPSolution(NamedTuple):
         return self.status == Status.SOLVED
 
 
-def _solve_impl(qp: CanonicalQP,
-                params: SolverParams,
-                x0: Optional[jax.Array],
-                y0: Optional[jax.Array],
-                l1_weight: Optional[jax.Array] = None,
-                l1_center: Optional[jax.Array] = None) -> QPSolution:
+def _prepare_impl(qp: CanonicalQP,
+                  params: SolverParams,
+                  x0: Optional[jax.Array],
+                  y0: Optional[jax.Array],
+                  l1_weight: Optional[jax.Array] = None,
+                  l1_center: Optional[jax.Array] = None):
+    """The front half of :func:`_solve_impl`: equilibrate and map warm
+    starts / the native L1 term into the scaled frame. Returns
+    ``(scaled, scaling, x0_s, y0_s, l1w_s, l1c_s)``. Split out so
+    segment-stepped drivers (batch compaction, continuous serving) run
+    the identical preparation the fused solve does."""
     if params.scaling_mode == "factored":
         scaled, scaling = equilibrate_factored(qp)
     elif params.scaling_mode == "ruiz":
@@ -88,9 +96,28 @@ def _solve_impl(qp: CanonicalQP,
     # sum_i (c * w_i * D_i) |xhat_i - c_i / D_i| in the scaled frame.
     l1w_s = None if l1_weight is None else scaling.c * l1_weight * scaling.D
     l1c_s = None if l1_center is None else l1_center / scaling.D
+    return scaled, scaling, x0_s, y0_s, l1w_s, l1c_s
 
-    state = admm_solve(scaled, scaling, params, x0=x0_s, y0=y0_s,
-                       l1_weight=l1w_s, l1_center=l1c_s)
+
+def _finalize_impl(qp: CanonicalQP,
+                   scaled: CanonicalQP,
+                   scaling: Scaling,
+                   state: ADMMState,
+                   params: SolverParams,
+                   l1_weight: Optional[jax.Array] = None,
+                   l1_center: Optional[jax.Array] = None,
+                   l1w_s: Optional[jax.Array] = None,
+                   l1c_s: Optional[jax.Array] = None) -> QPSolution:
+    """The tail half of :func:`_solve_impl`: retire a still-``RUNNING``
+    state to ``MAX_ITER`` (idempotent — ``admm_solve`` already did it;
+    segment-budget drivers hand in raw stepper states), then polish,
+    unscale, and assemble the :class:`QPSolution`. This is the
+    "MAX_ITER + polish fallback": a lane retired out of budget still
+    gets the active-set polish, and is re-graded ``SOLVED`` when the
+    polished point actually meets tolerance."""
+    state = state._replace(
+        status=jnp.where(state.status == Status.RUNNING, Status.MAX_ITER,
+                         state.status).astype(jnp.int32))
     x, z, w, y, mu = state.x, state.z, state.w, state.y, state.mu
 
     # Active-set polish. With a live L1 term the polish is prox-aware
@@ -171,6 +198,20 @@ def _solve_impl(qp: CanonicalQP,
     )
 
 
+def _solve_impl(qp: CanonicalQP,
+                params: SolverParams,
+                x0: Optional[jax.Array],
+                y0: Optional[jax.Array],
+                l1_weight: Optional[jax.Array] = None,
+                l1_center: Optional[jax.Array] = None) -> QPSolution:
+    scaled, scaling, x0_s, y0_s, l1w_s, l1c_s = _prepare_impl(
+        qp, params, x0, y0, l1_weight, l1_center)
+    state = admm_solve(scaled, scaling, params, x0=x0_s, y0=y0_s,
+                       l1_weight=l1w_s, l1_center=l1c_s)
+    return _finalize_impl(qp, scaled, scaling, state, params,
+                          l1_weight, l1_center, l1w_s, l1c_s)
+
+
 @functools.partial(jax.jit, static_argnames=("params",))
 def solve_qp(qp: CanonicalQP,
              params: SolverParams = SolverParams(),
@@ -205,6 +246,110 @@ def _solve_batch_impl(qp: CanonicalQP,
     )(qp, x0, y0, l1_weight, l1_center)
 
 
+# ---------------------------------------------------------------------------
+# Segment-stepped batch API (the compaction / continuous-batching core)
+# ---------------------------------------------------------------------------
+#
+# The three phases of ``_solve_batch_impl`` exposed separately, each
+# vmapped over a leading lane axis, so batch orchestration — run K
+# segments, retire/repack/refill lanes, keep going — can live *above*
+# the device program instead of inside one while_loop that charges
+# every lane for the slowest. Per-lane arithmetic is the exact code
+# the fused path runs (shared ``_prepare_impl`` / ``admm_segment_step``
+# / ``_finalize_impl``), which is what makes the compacted results
+# bit-identical for lanes that converge (pinned by
+# tests/test_compaction.py).
+
+def default_segment_budget(params: SolverParams) -> int:
+    """The per-lane segment budget that reproduces plain ``max_iter``
+    semantics: ``ceil(max_iter / check_interval)``. One definition,
+    shared by the compaction driver and the continuous batcher so the
+    two retirement policies cannot fork."""
+    import math
+
+    return max(1, math.ceil(params.max_iter / params.check_interval))
+
+
+def select_lanes(mask, new, old):
+    """Per-lane select over a pytree: ``mask`` is (b,), leaves are
+    (b, ...) — the same freeze the vmapped while_loop applies to lanes
+    whose cond went false. Shared by every segment-stepped driver so
+    the broadcast rule cannot drift."""
+    def sel(a, b):
+        m = mask.reshape(mask.shape + (1,) * (a.ndim - 1))
+        return jnp.where(m, a, b)
+
+    return jax.tree.map(sel, new, old)
+
+
+def prepare_batch(qp: CanonicalQP,
+                  params: SolverParams,
+                  x0: Optional[jax.Array] = None,
+                  y0: Optional[jax.Array] = None,
+                  l1_weight: Optional[jax.Array] = None,
+                  l1_center: Optional[jax.Array] = None):
+    """Equilibrate every lane and build its segment-loop carry.
+
+    Returns ``(scaled, scaling, carry, l1w_s, l1c_s)``, all with a
+    leading lane axis (the l1 leaves are ``None`` when no L1 term was
+    given — empty pytree subtrees, same convention as everywhere).
+    """
+    in_axes = tuple(None if a is None else 0
+                    for a in (qp, x0, y0, l1_weight, l1_center))
+
+    def one(q, xx, yy, lw, lc):
+        scaled, scaling, x0_s, y0_s, l1w_s, l1c_s = _prepare_impl(
+            q, params, xx, yy, lw, lc)
+        carry = admm_init(scaled, params, x0_s, y0_s)
+        return scaled, scaling, carry, l1w_s, l1c_s
+
+    return jax.vmap(one, in_axes=(0,) + in_axes[1:])(
+        qp, x0, y0, l1_weight, l1_center)
+
+
+def segment_step_batch(scaled: CanonicalQP,
+                       scaling: Scaling,
+                       carry: ADMMCarry,
+                       params: SolverParams,
+                       l1w_s: Optional[jax.Array] = None,
+                       l1c_s: Optional[jax.Array] = None) -> ADMMCarry:
+    """Advance every lane one residual-check segment (vmapped
+    :func:`porqua_tpu.qp.admm.admm_segment_step`). Per-lane status
+    lives in ``carry.state.status``."""
+    in_axes = (0, 0, 0,
+               None if l1w_s is None else 0,
+               None if l1c_s is None else 0)
+
+    def one(c, s, sc, lw, lc):
+        return admm_segment_step(c, s, sc, params, lw, lc)[0]
+
+    return jax.vmap(one, in_axes=in_axes)(carry, scaled, scaling,
+                                          l1w_s, l1c_s)
+
+
+def finalize_batch(qp: CanonicalQP,
+                   scaled: CanonicalQP,
+                   scaling: Scaling,
+                   state: ADMMState,
+                   params: SolverParams,
+                   l1_weight: Optional[jax.Array] = None,
+                   l1_center: Optional[jax.Array] = None,
+                   l1w_s: Optional[jax.Array] = None,
+                   l1c_s: Optional[jax.Array] = None) -> QPSolution:
+    """Polish + unscale + grade every lane (vmapped
+    :func:`_finalize_impl`). Still-``RUNNING`` lanes (retired out of
+    segment budget) are graded ``MAX_ITER`` and get the polish
+    fallback, exactly like the fused path's out-of-iterations exit."""
+    axes = [0, 0, 0, 0] + [None if a is None else 0
+                           for a in (l1_weight, l1_center, l1w_s, l1c_s)]
+
+    def one(q, s, sc, st, lw, lc, lws, lcs):
+        return _finalize_impl(q, s, sc, st, params, lw, lc, lws, lcs)
+
+    return jax.vmap(one, in_axes=tuple(axes))(
+        qp, scaled, scaling, state, l1_weight, l1_center, l1w_s, l1c_s)
+
+
 @functools.partial(jax.jit, static_argnames=("params",))
 def solve_qp_batch(qp: CanonicalQP,
                    params: SolverParams = SolverParams(),
@@ -233,6 +378,86 @@ def batch_shape_struct(batch: int, n: int, m: int,
         Pf=None if factor_rows is None else s(factor_rows, n),
         Pdiag=None if factor_rows is None else s(n),
     )
+
+
+def continuous_entries(params: SolverParams = SolverParams()):
+    """The continuous-batching entry closures ``(admit, step,
+    finalize)`` as pure functions — :func:`aot_compile_continuous`
+    lowers exactly these, and the GC101–103 jaxpr contracts
+    (:mod:`porqua_tpu.analysis.contracts`) trace the same objects, so
+    the compiled programs and the machine-checked ones cannot drift."""
+    _sel = select_lanes
+
+    def admit(qp, x0, y0, mask, scaled_old, scaling_old, carry_old):
+        scaled, scaling, carry, _, _ = prepare_batch(qp, params, x0, y0)
+        return (qp,
+                _sel(mask, scaled, scaled_old),
+                _sel(mask, scaling, scaling_old),
+                _sel(mask, carry, carry_old))
+
+    def step(scaled, scaling, carry, active):
+        new = segment_step_batch(scaled, scaling, carry, params)
+        new = _sel(active, new, carry)
+        return new, new.state.status, new.state.iters
+
+    def fin(qp, scaled, scaling, state):
+        return finalize_batch(qp, scaled, scaling, state, params)
+
+    return admit, step, fin
+
+
+def aot_compile_continuous(qp_struct: CanonicalQP,
+                           params: SolverParams = SolverParams(),
+                           device=None):
+    """AOT-compile the continuous-batching executable triple for one
+    static cohort shape; returns ``(admit, step, finalize, structs)``.
+
+    The serving cohort holds a fixed number of lanes whose membership
+    changes at segment boundaries (freed slots refilled from the
+    queue), so the fused solve program is split in three — each a
+    fixed-shape program compiled once per ``(bucket, slots, device)``:
+
+    * ``admit(qp, x0, y0, mask, scaled_old, scaling_old, carry_old)
+      -> (qp, scaled, scaling, carry)`` — equilibrate + carry-init for
+      every slot, then per-lane select: admitted slots take the fresh
+      state, others keep theirs. ``qp`` is passed through so the
+      cohort's problem data stays device-resident for ``finalize``.
+    * ``step(scaled, scaling, carry, active) -> (carry, status,
+      iters)`` — one residual-check segment, with inactive lanes
+      frozen by the same select the vmapped while_loop applies.
+    * ``finalize(qp, scaled, scaling, state) -> QPSolution`` — polish
+      + unscale + grade for the whole cohort; the batcher reads only
+      the retiring lanes' rows. Still-``RUNNING`` lanes retired out of
+      segment budget grade ``MAX_ITER`` with the polish fallback.
+
+    ``structs`` is ``(scaled, scaling, carry)`` as shape structs — the
+    batcher materializes the zero initial state from it at cohort
+    creation.
+    """
+    B = qp_struct.q.shape[0]
+    n, m = qp_struct.q.shape[-1], qp_struct.l.shape[-1]
+    dtype = qp_struct.q.dtype
+    x0_s = jax.ShapeDtypeStruct((B, n), dtype)
+    y0_s = jax.ShapeDtypeStruct((B, m), dtype)
+    mask_s = jax.ShapeDtypeStruct((B,), jnp.bool_)
+
+    admit, step, fin = continuous_entries(params)
+
+    structs = jax.eval_shape(
+        lambda q, x, y: prepare_batch(q, params, x, y)[:3],
+        qp_struct, x0_s, y0_s)
+    scaled_s, scaling_s, carry_s = structs
+    ctx = (jax.default_device(device) if device is not None
+           else contextlib.nullcontext())
+    with ctx:
+        admit_exe = jax.jit(admit).lower(
+            qp_struct, x0_s, y0_s, mask_s,
+            scaled_s, scaling_s, carry_s).compile()
+        step_exe = jax.jit(step).lower(
+            scaled_s, scaling_s, carry_s, mask_s).compile()
+        fin_exe = jax.jit(fin).lower(
+            qp_struct, scaled_s, scaling_s, carry_s.state).compile()
+    return admit_exe, step_exe, fin_exe, structs
 
 
 def aot_compile_batch(qp_struct: CanonicalQP,

@@ -33,6 +33,7 @@ from porqua_tpu.serve.batcher import (
     WarmStartCache,
     problem_fingerprint,
 )
+from porqua_tpu.serve.continuous import ContinuousBatcher
 from porqua_tpu.serve.bucketing import (
     Bucket,
     BucketLadder,
@@ -53,6 +54,7 @@ __all__ = [
     "Bucket",
     "BucketLadder",
     "BucketOverflow",
+    "ContinuousBatcher",
     "DeadlineExpired",
     "DeviceHealth",
     "ExecutableCache",

@@ -334,15 +334,10 @@ class MicroBatcher:
         rr = None if rp is None else np.asarray(sol.ring_rho)
         done = time.monotonic()
         for i, r in enumerate(live):
-            ok = int(status[i]) == Status.SOLVED
-            if ok and r.warm_key is not None and self.warm_cache is not None:
-                self.warm_cache.put((r.warm_key, bucket), xs[i], ys[i])
-            # Spans and metrics are recorded BEFORE the future resolves:
-            # a caller synchronizing on result() may export the trace
-            # the moment its last future fires, and the request's own
+            # Spans are recorded BEFORE the future resolves: a caller
+            # synchronizing on result() may export the trace the
+            # moment its last future fires, and the request's own
             # spans must already be in the recorder by then.
-            m.observe_latency(done - r.submitted)
-            m.inc("completed")
             if obs is not None and r.trace_id is not None:
                 batch_args = {"bucket": f"{bucket.n}x{bucket.m}",
                               "slots": slots, "real": len(live),
@@ -353,29 +348,51 @@ class MicroBatcher:
                                  trace_id=r.trace_id, **batch_args)
                 obs.spans.record("resolve", t_exec1, done,
                                  trace_id=r.trace_id)
-            r.future.set_result(SolveResult(
-                # Copy: the row slice is a view whose .base is the
-                # whole (slots, n) batch array — a caller retaining
-                # results would pin every batch buffer alive.
-                x=np.array(xs[i, :r.n_orig], copy=True),
-                status=int(status[i]),
-                iters=int(iters[i]),
-                prim_res=float(prim[i]),
-                dual_res=float(dual[i]),
-                obj_val=float(obj[i]),
-                latency_s=done - r.submitted,
-                warm_started=warm[i],
-                device=device_label,
-                trace_id=r.trace_id,
-                ring_prim=None if rp is None else np.array(rp[i],
-                                                           copy=True),
-                ring_dual=None if rd is None else np.array(rd[i],
-                                                           copy=True),
-                ring_rho=None if rr is None else np.array(rr[i],
-                                                          copy=True),
-            ))
+            self._finish_request(r, bucket, i, xs, ys, status, iters,
+                                 prim, dual, obj, rp, rd, rr, done,
+                                 device_label, warm[i])
         m.observe_batch(len(live), slots, solve_s,
                         float(iters[:len(live)].mean()))
+
+    def _finish_request(self, r: SolveRequest, bucket: Bucket, i: int,
+                        xs, ys, status, iters, prim, dual, obj,
+                        rp, rd, rr, done: float, device_label: str,
+                        warm_started: bool) -> None:
+        """Shared per-request retirement: warm-start cache put, the
+        latency / completed / per-lane-Status metrics, and future
+        resolution with the trimmed, copied :class:`SolveResult`. One
+        copy for both batchers (the continuous batcher retires lanes
+        at segment boundaries through this exact sequence), so a new
+        metric or result field cannot land in one path only. Callers
+        record their spans BEFORE calling."""
+        m = self.metrics
+        ok = int(status[i]) == Status.SOLVED
+        if ok and r.warm_key is not None and self.warm_cache is not None:
+            self.warm_cache.put((r.warm_key, bucket), xs[i], ys[i])
+        m.observe_latency(done - r.submitted)
+        m.inc("completed")
+        # Per-lane terminal Status at the API boundary: aggregate
+        # solved counts alone cannot distinguish a MAX_ITER lane from
+        # a converged one.
+        m.observe_status(int(status[i]))
+        r.future.set_result(SolveResult(
+            # Copy: the row slice is a view whose .base is the whole
+            # (slots, n) batch array — a caller retaining results
+            # would pin every batch buffer alive.
+            x=np.array(xs[i, :r.n_orig], copy=True),
+            status=int(status[i]),
+            iters=int(iters[i]),
+            prim_res=float(prim[i]),
+            dual_res=float(dual[i]),
+            obj_val=float(obj[i]),
+            latency_s=done - r.submitted,
+            warm_started=warm_started,
+            device=device_label,
+            trace_id=r.trace_id,
+            ring_prim=None if rp is None else np.array(rp[i], copy=True),
+            ring_dual=None if rd is None else np.array(rd[i], copy=True),
+            ring_rho=None if rr is None else np.array(rr[i], copy=True),
+        ))
 
     def _execute(self, bucket: Bucket, slots: int, dtype, qp, x0, y0,
                  live: List[SolveRequest]):

@@ -33,6 +33,7 @@ from porqua_tpu.qp.canonical import CanonicalQP, pad_qp
 from porqua_tpu.qp.solve import (
     SolverParams,
     aot_compile_batch,
+    aot_compile_continuous,
     batch_shape_struct,
 )
 
@@ -164,10 +165,21 @@ class ExecutableCache:
         """The compiled executable for one (bucket, batch, device)."""
         return self._get(bucket, slots, dtype, device)[0]
 
-    def _get(self, bucket: Bucket, slots: int, dtype, device=None):
+    def get_continuous(self, bucket: Bucket, slots: int, dtype,
+                       device=None):
+        """The continuous-batching executable triple ``(admit, step,
+        finalize, structs)`` for one cohort shape (see
+        :func:`porqua_tpu.qp.solve.aot_compile_continuous`). Cached and
+        warmup-accounted exactly like the one-shot executables — the
+        triple is one cache entry / one compile event."""
+        return self._get(bucket, slots, dtype, device,
+                         kind="continuous")[0]
+
+    def _get(self, bucket: Bucket, slots: int, dtype, device=None,
+             kind: str = "solve"):
         """(executable, missed): ``missed`` lets prewarm count ITS OWN
         compiles exactly instead of diffing cache sizes across threads."""
-        key = (bucket, int(slots), np.dtype(dtype).str,
+        key = (kind, bucket, int(slots), np.dtype(dtype).str,
                self._device_key(device))
         with self._lock:
             exe = self._cache.get(key)
@@ -186,7 +198,8 @@ class ExecutableCache:
                            and not self._warming.get((bucket, dev_key)))
             try:
                 sanitize.note_compile(
-                    f"bucket={bucket} slots={int(slots)} device={dev_key}",
+                    f"kind={kind} bucket={bucket} slots={int(slots)} "
+                    f"device={dev_key}",
                     post_warmup=post_warmup)
             except sanitize.SanitizerError as exc:
                 if self.events is not None:
@@ -200,7 +213,11 @@ class ExecutableCache:
             struct = batch_shape_struct(
                 int(slots), bucket.n, bucket.m, dtype=dtype,
                 factor_rows=bucket.factor_rows)
-            exe = aot_compile_batch(struct, self.params, device=device)
+            if kind == "continuous":
+                exe = aot_compile_continuous(struct, self.params,
+                                             device=device)
+            else:
+                exe = aot_compile_batch(struct, self.params, device=device)
             self._cache[key] = exe
             seconds = time.perf_counter() - t0
             if self.metrics is not None:
@@ -222,7 +239,8 @@ class ExecutableCache:
             return bool(self._warmed_devices)
 
     def prewarm(self, bucket: Bucket, max_batch: int, dtype,
-                device=None) -> int:
+                device=None, continuous: bool = False,
+                include_solve: bool = True) -> int:
         """Compile the whole slot ladder for one bucket; returns the
         number of executables compiled (cache misses). ``(bucket,
         device)``'s compiles count as warmup for the duration (so
@@ -230,14 +248,25 @@ class ExecutableCache:
         fix, not itself a violation), while concurrent misses on other
         buckets or devices stay enforced. The device is sealed only
         when the whole ladder compiled — a prewarm that died partway
-        must not arm enforcement over a half-warm cache."""
+        must not arm enforcement over a half-warm cache.
+        ``continuous=True`` compiles the continuous-batching triple
+        for every rung (cohorts are created at ladder sizes, so any
+        cohort a ``ContinuousBatcher`` can mint dispatches into an
+        already-compiled triple); ``include_solve=False`` skips the
+        one-shot solve executables — a continuous service never
+        dispatches them, and at production shapes each dead AOT
+        compile costs real startup seconds."""
         compiled = 0
         key = (bucket, self._device_key(device))
         with self._lock:
             self._warming[key] = self._warming.get(key, 0) + 1
         try:
             for s in slot_ladder(max_batch):
-                compiled += self._get(bucket, s, dtype, device)[1]
+                if include_solve:
+                    compiled += self._get(bucket, s, dtype, device)[1]
+                if continuous:
+                    compiled += self._get(bucket, s, dtype, device,
+                                          kind="continuous")[1]
         finally:
             with self._lock:
                 depth = self._warming[key] - 1

@@ -1,0 +1,487 @@
+"""Continuous batching: freed cohort slots refill at segment boundaries.
+
+The classic :class:`~porqua_tpu.serve.batcher.MicroBatcher` dispatches
+a batch as ONE fused solve, so every request in it waits for the
+slowest lane and the queue waits for the whole batch to drain — the
+straggler tax, at the serving layer. This batcher turns the
+segment-level compaction idea into the loop inference-serving stacks
+run: a **cohort** of fixed device shape steps one residual-check
+segment at a time (:func:`porqua_tpu.qp.solve.aot_compile_continuous`),
+and at every boundary
+
+* lanes whose status left ``RUNNING`` — or that exhausted their
+  per-lane ``segment_budget`` — retire immediately: one cohort-wide
+  ``finalize`` (polish + unscale + grade; an out-of-budget lane
+  becomes ``MAX_ITER`` with the polish fallback) and their futures
+  resolve *now*, not when the whole batch drains;
+* the freed slots are refilled from the queue with warm-started
+  requests via the ``admit`` executable (equilibrate + carry init for
+  the new lanes, select keeps everyone else's state bit-intact).
+
+All three programs are fixed-shape and AOT-compiled per
+``(bucket, slots, device)`` through the same
+:class:`~porqua_tpu.serve.bucketing.ExecutableCache` (prewarm with
+``continuous=True``), so steady state performs zero compiles. Work
+accounting goes to the new ``ServeMetrics`` segment counters
+(``lane_segments`` / ``wasted_lane_segments`` /
+``segment_occupancy_mean``), and every request's terminal
+:class:`~porqua_tpu.qp.admm.Status` is surfaced in ``SolveResult`` and
+the status counters.
+
+Device-fault containment: a cohort's carry lives on one device, so a
+mid-flight failure cannot migrate — the cohort's requests fail loudly
+(``SolveError``), the breaker records the fault, and the *next* cohort
+forms on whatever device the health manager then trusts. Sanitizer
+violations (``PORQUA_SANITIZE=1``) fail the cohort WITHOUT opening the
+breaker, same as the classic dispatch path.
+
+Known cost (acceptable at current serve shapes, the next optimization
+lever for big-n buckets): the fixed-shape ``admit`` program takes the
+whole stacked cohort problem buffer, so each admission boundary pays a
+full-cohort h2d plus an all-slots equilibrate of which only the
+admitted rows survive the select. Making admission O(admitted) needs a
+device-resident problem buffer updated by ``dynamic_update_slice`` (the
+same pattern the repack uses) — a per-row admit executable, left for a
+follow-up.
+"""
+
+from __future__ import annotations
+
+import collections
+import queue
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from porqua_tpu.analysis import sanitize
+from porqua_tpu.qp.admm import Status
+from porqua_tpu.qp.canonical import CanonicalQP, stack_qps
+from porqua_tpu.serve.batcher import (
+    DeadlineExpired,
+    MicroBatcher,
+    SolveError,
+    SolveRequest,
+)
+from porqua_tpu.serve.bucketing import Bucket, slot_count
+
+__all__ = ["ContinuousBatcher"]
+
+
+def _neutral_qp(bucket: Bucket, dtype) -> CanonicalQP:
+    """The problem an empty slot holds: identity objective, free rows,
+    pinned-to-zero variables. Empty slots are select-frozen (never in
+    the active mask), but the step program still *computes* them
+    before discarding — neutral, well-conditioned data keeps those
+    dead factorizations numerically tame."""
+    n, m = bucket.n, bucket.m
+    qp = CanonicalQP(
+        P=np.eye(n, dtype=dtype), q=np.zeros(n, dtype),
+        C=np.zeros((m, n), dtype),
+        l=np.full(m, -np.inf, dtype), u=np.full(m, np.inf, dtype),
+        lb=np.zeros(n, dtype), ub=np.zeros(n, dtype),
+        var_mask=np.zeros(n, dtype), row_mask=np.zeros(m, dtype),
+        constant=np.zeros((), dtype))
+    if bucket.factor_rows is not None:
+        # Factor convention P == 2 Pf'Pf + diag(Pdiag): zeros + unit
+        # diagonal completion reproduces the identity exactly.
+        qp = qp._replace(
+            Pf=np.zeros((bucket.factor_rows, n), dtype),
+            Pdiag=np.ones(n, dtype))
+    return qp
+
+
+class _Cohort:
+    """One fixed-shape, device-resident lane group."""
+
+    def __init__(self, bucket: Bucket, slots: int, dtype, device,
+                 exes) -> None:
+        self.bucket = bucket
+        self.slots = slots
+        self.dtype = dtype
+        self.device = device
+        self.admit_exe, self.step_exe, self.fin_exe, structs = exes
+        self.reqs: List[Optional[SolveRequest]] = [None] * slots
+        self.warm = [False] * slots
+        self.seg_count = np.zeros(slots, np.int64)
+        self.admit_t = np.zeros(slots, np.float64)
+        self.active = np.zeros(slots, bool)
+        self.neutral = _neutral_qp(bucket, dtype)
+        # ONE persistent stacked host buffer for the cohort's problem
+        # data: admissions write only their slot's rows in place
+        # (np.stack below allocates fresh writable arrays). Restacking
+        # the whole cohort per admission boundary would cost an
+        # O(slots x n^2) host memcpy on the dispatch thread for the
+        # common one-lane-in/one-lane-out case.
+        self.qp_stack: CanonicalQP = stack_qps([self.neutral] * slots,
+                                               stack_fn=np.stack)
+        self.x0 = np.zeros((slots, bucket.n), dtype)
+        self.y0 = np.zeros((slots, bucket.m), dtype)
+        # Device state; the zero initial trees are materialized from
+        # the AOT structs so the first admit has concrete "old" args.
+        import jax
+
+        zeros = jax.tree.map(lambda s: np.zeros(s.shape, s.dtype),
+                             structs)
+        self.scaled, self.scaling, self.carry = zeros
+        self.qp_dev = None
+        self.staged: List[int] = []     # slots awaiting an admit
+        # Set when the queue outgrows this cohort: stop refilling so
+        # it drains and a larger replacement forms from the backlog (a
+        # cohort's device shape is fixed at creation — growth happens
+        # by replacement, never by resize).
+        self.no_refill = False
+
+    def write_slot(self, slot: int, qp: CanonicalQP) -> None:
+        """Overwrite one slot's rows of the stacked problem buffer
+        (the padded request and the neutral problem share the bucket's
+        exact pytree structure, pad_qp normalizes Pdiag presence)."""
+        for name, dst in zip(self.qp_stack._fields, self.qp_stack):
+            if dst is None:
+                continue
+            dst[slot] = np.asarray(getattr(qp, name))
+
+    def free_slots(self) -> List[int]:
+        return [i for i, r in enumerate(self.reqs) if r is None]
+
+    def occupied(self) -> int:
+        return sum(r is not None for r in self.reqs)
+
+    def empty(self) -> bool:
+        return self.occupied() == 0
+
+
+class ContinuousBatcher(MicroBatcher):
+    """Drop-in MicroBatcher variant running the continuous loop.
+
+    Cohorts form under the same size/age policy as classic batches
+    (and at the same power-of-two ladder sizes), but once running they
+    admit/retire lanes at every segment boundary instead of draining
+    whole. ``segment_budget`` bounds any single lane's segments; the
+    default is the solver's own ``ceil(max_iter / check_interval)``,
+    i.e. pure ``max_iter`` semantics.
+    """
+
+    def __init__(self, *args, params=None,
+                 segment_budget: Optional[int] = None, **kwargs) -> None:
+        super().__init__(*args, **kwargs)
+        if params is None:
+            params = self.cache.params
+        self.params = params
+        from porqua_tpu.qp.solve import default_segment_budget
+
+        if segment_budget is not None and segment_budget < 1:
+            raise ValueError("segment_budget must be >= 1")
+        # Clamped to the solver's own max_iter expressed in segments:
+        # the continuous step program has no iters < max_iter gate (the
+        # host budget is the only brake), so a wider budget here would
+        # run lanes past max_iter and fork the retirement policy from
+        # the compaction driver's lane_active / the fused while_loop.
+        self.segment_budget = min(
+            int(segment_budget or default_segment_budget(params)),
+            default_segment_budget(params))
+        self._cohorts: Dict[Bucket, _Cohort] = {}
+
+    # -- loop ---------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            draining = self._stopping.is_set()
+            busy = any(not c.empty() for c in self._cohorts.values())
+            try:
+                timeout = (1e-4 if busy or draining
+                           else self._next_wakeup(time.monotonic()))
+                req = self.queue.get(timeout=timeout)
+                self._route(req)
+                while True:  # drain whatever arrived together
+                    try:
+                        self._route(self.queue.get_nowait())
+                    except queue.Empty:
+                        break
+            except queue.Empty:
+                pass
+
+            now = time.monotonic()
+            for bucket in list(self._pending):
+                dq = self._pending[bucket]
+                if not dq:
+                    del self._pending[bucket]
+                    continue
+                if bucket not in self._cohorts and (
+                        draining
+                        or len(dq) >= self.max_batch
+                        or now - dq[0].submitted >= self.max_wait_s):
+                    self._make_cohort_safe(bucket, dq)
+
+            for bucket, cohort in list(self._cohorts.items()):
+                self._tick_safe(bucket, cohort)
+                if cohort.empty() and not cohort.staged \
+                        and (cohort.no_refill
+                             or not self._pending.get(bucket)):
+                    # A drained no-refill cohort makes way for a
+                    # larger replacement sized from today's backlog.
+                    del self._cohorts[bucket]
+
+            if draining and self.queue.empty() and not self._pending \
+                    and all(c.empty() and not c.staged
+                            for c in self._cohorts.values()):
+                return
+
+    # -- cohort lifecycle --------------------------------------------
+
+    def _fail_pending(self, dq: "collections.deque", exc) -> None:
+        while dq:
+            r = dq.popleft()
+            if not r.future.done():
+                self.metrics.inc("failed")
+                r.future.set_exception(SolveError(
+                    f"continuous cohort creation failed: {exc!r}"))
+
+    def _make_cohort_safe(self, bucket: Bucket,
+                          dq: "collections.deque") -> None:
+        try:
+            device = self.health.device()
+            dtype = np.dtype(np.asarray(dq[0].qp.q).dtype)
+            slots = slot_count(min(len(dq), self.max_batch),
+                               self.max_batch)
+            exes = self.cache.get_continuous(bucket, slots, dtype, device)
+            self._cohorts[bucket] = _Cohort(bucket, slots, dtype,
+                                            device, exes)
+        except sanitize.SanitizerError as exc:
+            # A policy violation (e.g. a refused post-warmup compile)
+            # is not a device fault: fail these requests loudly and
+            # leave the circuit breaker closed — the same carve-out
+            # MicroBatcher._execute makes.
+            if self.obs is not None:
+                self.obs.events.emit(
+                    "sanitizer_violation", "error", what="cohort_create",
+                    bucket=f"{bucket.n}x{bucket.m}", detail=str(exc))
+            self._fail_pending(dq, exc)
+        except Exception as exc:  # noqa: BLE001 - containment boundary
+            self.health.record_failure(exc)
+            self.metrics.inc("dispatch_failures")
+            self._fail_pending(dq, exc)
+
+    def _stage_admissions(self, bucket: Bucket, cohort: _Cohort) -> None:
+        dq = self._pending.get(bucket)
+        if not dq:
+            return
+        free = cohort.free_slots()
+        now = time.monotonic()
+        m = self.metrics
+        while dq and free:
+            r = dq.popleft()
+            if r.deadline is not None and now > r.deadline:
+                m.inc("expired")
+                if self.obs is not None and r.trace_id is not None:
+                    self.obs.spans.record("queue_wait", r.submitted, now,
+                                          trace_id=r.trace_id,
+                                          expired=True)
+                    # Same structured event the classic dispatch path
+                    # emits: every expiry is an event, not just a
+                    # counter bump (the PR 3 event-log invariant).
+                    self.obs.events.emit(
+                        "deadline_expired", "warn", trace_id=r.trace_id,
+                        queued_s=round(now - r.submitted, 4),
+                        late_s=round(now - r.deadline, 4))
+                r.future.set_exception(DeadlineExpired(
+                    f"deadline passed {now - r.deadline:.3f}s before "
+                    f"admission (queued {now - r.submitted:.3f}s)"))
+                continue
+            slot = free.pop(0)
+            m.observe_queue_wait(now - r.submitted)
+            if self.obs is not None and r.trace_id is not None:
+                self.obs.spans.record("queue_wait", r.submitted, now,
+                                      trace_id=r.trace_id)
+            cohort.reqs[slot] = r
+            cohort.write_slot(slot, r.qp)
+            cohort.seg_count[slot] = 0
+            cohort.warm[slot] = False
+            # Span-tiling anchor: queue_wait ends here, the request's
+            # "solve" span starts here (admit dispatch + all segments).
+            cohort.admit_t[slot] = now
+            cohort.x0[slot] = 0.0
+            cohort.y0[slot] = 0.0
+            if self.warm_cache is not None and r.warm_key is not None:
+                hit = self.warm_cache.get((r.warm_key, bucket))
+                if hit is not None:
+                    cohort.x0[slot], cohort.y0[slot] = hit
+                    cohort.warm[slot] = True
+                    m.inc("warm_hits")
+            cohort.staged.append(slot)
+
+    def _tick_safe(self, bucket: Bucket, cohort: _Cohort) -> None:
+        try:
+            self._tick(bucket, cohort)
+        except sanitize.SanitizerError as exc:
+            # Sanitizer policy violations never open the breaker (the
+            # documented invariant the classic _execute path keeps):
+            # fail this cohort loudly, breaker stays closed.
+            if self.obs is not None:
+                self.obs.events.emit(
+                    "sanitizer_violation", "error", what="cohort_tick",
+                    bucket=f"{bucket.n}x{bucket.m}", detail=str(exc))
+            self._fail_cohort(bucket, cohort, exc)
+        except Exception as exc:  # noqa: BLE001 - containment boundary
+            self.health.record_failure(exc)
+            self.metrics.inc("dispatch_failures")
+            if self.obs is not None:
+                self.obs.events.emit(
+                    "dispatch_failure", "error",
+                    bucket=f"{bucket.n}x{bucket.m}", continuous=True,
+                    error=f"{type(exc).__name__}: {exc}")
+            self._fail_cohort(bucket, cohort, exc)
+
+    def _fail_cohort(self, bucket: Bucket, cohort: _Cohort, exc) -> None:
+        for r in cohort.reqs:
+            if r is not None and not r.future.done():
+                self.metrics.inc("failed")
+                r.future.set_exception(SolveError(
+                    f"continuous cohort failed: {exc!r}"))
+        self._cohorts.pop(bucket, None)
+
+    @staticmethod
+    def _call(exe, device, *args):
+        """One compiled dispatch with the sanitizer's transfer
+        discipline (mirrors ``MicroBatcher._call_executable``): the
+        intentional h2d of staged host arrays is made explicit, and
+        the dispatch runs under ``transfer_guard("disallow")``."""
+        if not sanitize.enabled():
+            return exe(*args)
+        import jax
+
+        args = (jax.device_put(args, device) if device is not None
+                else jax.device_put(args))
+        with sanitize.transfer_guard():
+            try:
+                return exe(*args)
+            except Exception as exc:  # noqa: BLE001 - classify below
+                msg = str(exc)
+                if "isallow" in msg and "transfer" in msg.lower():
+                    raise sanitize.SanitizerError(
+                        f"implicit transfer inside the continuous "
+                        f"dispatch hot path: {exc}") from exc
+                raise
+
+    def _tick(self, bucket: Bucket, cohort: _Cohort) -> None:
+        import jax
+
+        m = self.metrics
+        dq = self._pending.get(bucket)
+        if (dq and not cohort.no_refill and cohort.slots < self.max_batch
+                and len(dq) > cohort.slots):
+            # The queue outgrew this cohort (e.g. it was minted from
+            # the first trickle of a ramping stream): without this, a
+            # small cohort would permanently cap the bucket's
+            # throughput — admissions are limited to its freed slots
+            # and the cohort never empties under sustained load. Stop
+            # refilling; in-flight lanes finish normally, the cohort
+            # drains within their remaining segments, and a larger one
+            # forms from the backlog.
+            cohort.no_refill = True
+            m.inc("cohort_replacements")
+        if not cohort.no_refill:
+            self._stage_admissions(bucket, cohort)
+
+        if cohort.staged:
+            mask = np.zeros(cohort.slots, bool)
+            mask[cohort.staged] = True
+            out = self._call(
+                cohort.admit_exe, cohort.device, cohort.qp_stack,
+                cohort.x0, cohort.y0, mask, cohort.scaled,
+                cohort.scaling, cohort.carry)
+            cohort.qp_dev, cohort.scaled, cohort.scaling, cohort.carry = out
+            cohort.active[cohort.staged] = True
+            m.inc("lanes_admitted", len(cohort.staged))
+            cohort.staged = []
+
+        if not cohort.active.any():
+            return
+
+        m.observe_queue_depth(self.queue.qsize() + sum(
+            len(d) for d in self._pending.values()))
+        t0 = time.monotonic()
+        active_dev = cohort.active.copy()
+        carry, status, _iters = self._call(
+            cohort.step_exe, cohort.device, cohort.scaled,
+            cohort.scaling, cohort.carry, active_dev)
+        cohort.carry = carry
+        # The per-boundary control readout: ONE small explicit d2h
+        # fetch (the repack/step program itself is sync-free — the
+        # GC101-103 contracts trace it). Final iteration counts come
+        # from the finalize output at retirement; fetching the step's
+        # iters here would be a second blocking sync nothing reads.
+        status_h = np.asarray(jax.device_get(status))
+        step_s = time.monotonic() - t0
+        n_live = int(np.sum(active_dev & np.array(
+            [r is not None for r in cohort.reqs])))
+        # Every boundary is a device dispatch: feed the batch/
+        # occupancy/solve-seconds aggregates here (not only at
+        # retirement boundaries, which would undercount device time
+        # and skew occupancy toward retirements/slots).
+        m.observe_segments(n_live, cohort.slots, step_s)
+        cohort.seg_count[active_dev] += 1
+
+        retire: List[int] = []
+        for i, r in enumerate(cohort.reqs):
+            if r is None or not cohort.active[i]:
+                continue
+            if status_h[i] != Status.RUNNING:
+                retire.append(i)
+            elif cohort.seg_count[i] >= self.segment_budget:
+                m.inc("lanes_retired_budget")
+                retire.append(i)
+        # (Slots without a request are never in `active` — they are
+        # select-frozen from creation on — so no separate bookkeeping.)
+
+        if not retire:
+            return
+
+        sol = self._call(cohort.fin_exe, cohort.device, cohort.qp_dev,
+                         cohort.scaled, cohort.scaling, cohort.carry.state)
+        t_fin = time.monotonic()
+        # Fetch ONLY the retiring lanes' rows: the finalize output
+        # covers the whole cohort, but under steady load a boundary
+        # typically retires one or two lanes — a full-cohort d2h of
+        # x/y/rings per boundary would tax the single dispatch thread
+        # for rows nothing reads. The device-side gather is tiny.
+        ridx = np.asarray(retire, dtype=np.int32)
+
+        def take(a):
+            return (None if a is None
+                    else np.asarray(jax.device_get(a[ridx])))
+
+        xs, ys = take(sol.x), take(sol.y)
+        fstat, fit = take(sol.status), take(sol.iters)
+        prim, dual, obj = (take(sol.prim_res), take(sol.dual_res),
+                           take(sol.obj_val))
+        rp = take(getattr(sol, "ring_prim", None))
+        rd = None if rp is None else take(sol.ring_dual)
+        rr = None if rp is None else take(sol.ring_rho)
+        done = time.monotonic()
+        device_label = (f"{cohort.device.platform}:{cohort.device.id}"
+                        if cohort.device is not None else "default")
+        for j, i in enumerate(retire):
+            r = cohort.reqs[i]
+            if self.obs is not None and r.trace_id is not None:
+                # Tile the request's wall-clock like the classic path:
+                # queue_wait ended at admission (admit_t), "solve"
+                # covers admit dispatch + every segment through the
+                # finalize dispatch, "resolve" the d2h fetch + fan-out.
+                self.obs.spans.record(
+                    "solve", cohort.admit_t[i], t_fin,
+                    trace_id=r.trace_id,
+                    bucket=f"{bucket.n}x{bucket.m}",
+                    slots=cohort.slots, continuous=True,
+                    segments=int(cohort.seg_count[i]),
+                    device=device_label)
+                self.obs.spans.record("resolve", t_fin, done,
+                                      trace_id=r.trace_id)
+            self._finish_request(r, bucket, j, xs, ys, fstat, fit,
+                                 prim, dual, obj, rp, rd, rr, done,
+                                 device_label, cohort.warm[i])
+            cohort.reqs[i] = None
+            cohort.write_slot(i, cohort.neutral)
+            cohort.active[i] = False
+        self.health.record_success()
+        m.observe_iters(float(fit.mean()), len(retire))

@@ -27,10 +27,14 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from porqua_tpu.qp.admm import Status
 from porqua_tpu.qp.canonical import CanonicalQP
 from porqua_tpu.qp.solve import SolverParams
 from porqua_tpu.serve.service import QueueFull, SolveService
 from porqua_tpu.tracking import synthetic_universe_np
+
+#: Status code -> name for the loadgen report's per-lane breakdown.
+_STATUS_NAMES = dict(Status.NAMES)
 
 #: The bench's serving solver defaults: the headline loose-eps config
 #: (bench.py base_params) — serving trades the polish for latency the
@@ -92,7 +96,9 @@ def run_loadgen(requests: List[CanonicalQP],
                 trace_out: Optional[str] = None,
                 events_out: Optional[str] = None,
                 ring_size: int = 0,
-                ring_samples: int = 8) -> Dict:
+                ring_samples: int = 8,
+                continuous: bool = False,
+                segment_budget: Optional[int] = None) -> Dict:
     """Drive ``requests`` through a :class:`SolveService`; return the
     report dict (throughput, percentiles, occupancy, recompiles).
 
@@ -133,7 +139,8 @@ def run_loadgen(requests: List[CanonicalQP],
         service = SolveService(params=params, max_batch=max_batch,
                                max_wait_ms=max_wait_ms,
                                queue_capacity=max(4 * max_batch, 1024),
-                               obs=obs)
+                               obs=obs, continuous=continuous,
+                               segment_budget=segment_budget)
         service.start()
     else:
         obs = service.obs
@@ -182,11 +189,18 @@ def run_loadgen(requests: List[CanonicalQP],
                 ticket.future.add_done_callback(lambda _f: sem.release())
             tickets.append(ticket)
         solved = 0
+        status_counts: Dict[str, int] = {}
         sampled = []  # first few results, for convergence-ring events
         for ticket in tickets:
             try:
                 res = service.result(ticket, timeout=300)
                 solved += int(res.found)
+                # Per-lane terminal Status at the report boundary: a
+                # MAX_ITER lane is distinguishable from a converged one
+                # (satellite of the compaction work — the tail was
+                # previously invisible outside aggregate solved counts).
+                name = _STATUS_NAMES.get(res.status, str(res.status))
+                status_counts[name] = status_counts.get(name, 0) + 1
                 if res.ring_prim is not None and len(sampled) < ring_samples:
                     sampled.append(res)
             except Exception as exc:  # noqa: BLE001 - reported, not fatal
@@ -245,10 +259,16 @@ def run_loadgen(requests: List[CanonicalQP],
             "rate": rate,
             "max_batch": max_batch,
             "max_wait_ms": max_wait_ms,
+            "continuous": continuous,
             "elapsed_s": elapsed,
             "throughput_solves_per_s": (n_done / elapsed
                                         if elapsed > 0 else 0.0),
             "solved": solved,
+            "status_counts": status_counts,
+            "segment_occupancy_mean": snap["segment_occupancy_mean"],
+            "wasted_lane_fraction": snap["wasted_lane_fraction"],
+            "lane_segments": snap["lane_segments"],
+            "lanes_retired_budget": snap["lanes_retired_budget"],
             "errors": len(errors),
             "dropped_arrivals": dropped,
             "error_sample": errors[:3],

@@ -44,7 +44,28 @@ COUNTERS = (
     "dispatch_failures",  # device executions that raised
     "probe_failures",   # health probes that failed
     "device_switches",  # circuit-breaker transitions
+    # Segment-level accounting (continuous batching / compaction):
+    "segment_dispatches",    # segment-step device dispatches
+    "lane_segments",         # slot-segments executed on live lanes
+    "wasted_lane_segments",  # slot-segments on retired/empty slots
+    "lanes_admitted",        # lanes admitted into a running cohort
+    "lanes_retired_budget",  # lanes retired at their segment budget
+    "cohort_replacements",   # cohorts drained for a larger replacement
+    # Per-lane terminal Status surfaced at the API boundary:
+    "status_solved",
+    "status_max_iter",
+    "status_primal_infeasible",
+    "status_dual_infeasible",
 )
+
+#: Status code -> counter suffix (mirrors porqua_tpu.qp.admm.Status —
+#: kept literal here so the metrics layer stays import-light).
+_STATUS_COUNTER = {
+    1: "status_solved",
+    2: "status_max_iter",
+    3: "status_primal_infeasible",
+    4: "status_dual_infeasible",
+}
 
 
 class ServeMetrics:
@@ -110,6 +131,41 @@ class ServeMetrics:
             self._iters_sum += iters_mean * real
             self._iters_n += real
 
+    def observe_segments(self, active: int, slots: int,
+                         seconds: float = 0.0) -> None:
+        """One segment-step dispatch over a cohort: ``active`` lanes
+        did useful work, ``slots - active`` slots were stepped (or
+        select-frozen) without a live request behind them. The ratio
+        is the segment occupancy the snapshot reports. A segment step
+        IS a device dispatch, so it also feeds the batch/occupancy/
+        solve-seconds aggregates — in continuous mode every boundary
+        is accounted, not just the ones where a lane retires."""
+        with self._lock:
+            self.counters["segment_dispatches"] += 1
+            self.counters["lane_segments"] += int(active)
+            self.counters["wasted_lane_segments"] += int(slots - active)
+            self.counters["batches"] += 1
+            self.counters["batch_slots"] += int(slots)
+            self.counters["batch_occupied"] += int(active)
+            self._solve_seconds += seconds
+
+    def observe_iters(self, iters_mean: float, n: int) -> None:
+        """Fold ``n`` requests' final device iteration counts into the
+        ``iters_mean`` aggregate (continuous mode records them at
+        retirement, separately from per-step dispatch accounting)."""
+        with self._lock:
+            self._iters_sum += iters_mean * n
+            self._iters_n += n
+
+    def observe_status(self, status: int) -> None:
+        """Count one request's terminal solver Status (per-lane codes
+        surfaced at the API boundary — a MAX_ITER lane is now
+        distinguishable from a converged one in the aggregates)."""
+        name = _STATUS_COUNTER.get(int(status))
+        if name is not None:
+            with self._lock:
+                self.counters[name] += 1
+
     def observe_queue_wait(self, seconds: float) -> None:
         """Accumulate one request's submit->dispatch wait (the batcher
         observes it at batch formation, so the figure covers queue time
@@ -139,12 +195,26 @@ class ServeMetrics:
             lat = np.asarray(self._latencies, dtype=np.float64)
             c = dict(self.counters)
             elapsed = time.monotonic() - self._window_start
+            slot_segments = (c["lane_segments"]
+                             + c["wasted_lane_segments"])
+            seg_occ = (c["lane_segments"] / slot_segments
+                       if slot_segments else 0.0)
             out: Dict[str, Any] = {
                 "t": time.time(),
                 "window_seconds": elapsed,
                 **c,
                 "occupancy_mean": (c["batch_occupied"] / c["batch_slots"]
                                    if c["batch_slots"] else 0.0),
+                # Serving-local definition: the share of stepped
+                # slot-segments carrying a live request (and its exact
+                # complement, exported under both names for scrape
+                # ergonomics). Deliberately NOT named
+                # wasted_iteration_fraction — that name belongs to
+                # bench.py's distribution-derived figure
+                # (1 - useful/dense segments), a different quantity.
+                "segment_occupancy_mean": seg_occ,
+                "wasted_lane_fraction": (1.0 - seg_occ
+                                         if slot_segments else 0.0),
                 "queue_depth_mean": (
                     self._queue_depth_sum / self._queue_depth_samples
                     if self._queue_depth_samples else 0.0),

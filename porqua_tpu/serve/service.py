@@ -244,8 +244,11 @@ class SolveService:
                  metrics: Optional[ServeMetrics] = None,
                  health: Optional[DeviceHealth] = None,
                  obs=None,
+                 continuous: bool = False,
+                 segment_budget: Optional[int] = None,
                  **health_kwargs) -> None:
         self.params = params
+        self.continuous = bool(continuous)
         self.fingerprint_warm_keys = bool(fingerprint_warm_keys)
         self.ladder = BucketLadder() if ladder is None else ladder
         self.metrics = ServeMetrics() if metrics is None else metrics
@@ -264,12 +267,26 @@ class SolveService:
             self.health.events = events
         self.cache = ExecutableCache(params, metrics=self.metrics,
                                      events=events)
-        self.batcher = MicroBatcher(
-            self.cache, self.health, self.metrics,
+        batcher_kwargs = dict(
             max_batch=max_batch, max_wait_ms=max_wait_ms,
             queue_capacity=queue_capacity,
             warm_cache=WarmStartCache(warm_capacity) if warm_start else None,
             obs=obs)
+        if self.continuous:
+            # Continuous batching: cohorts step one segment at a time,
+            # retire lanes the boundary they converge (or hit the
+            # per-lane segment budget -> MAX_ITER + polish fallback),
+            # and refill freed slots from the queue with warm-started
+            # requests instead of waiting for the batch to drain.
+            from porqua_tpu.serve.continuous import ContinuousBatcher
+
+            self.batcher = ContinuousBatcher(
+                self.cache, self.health, self.metrics,
+                params=params, segment_budget=segment_budget,
+                **batcher_kwargs)
+        else:
+            self.batcher = MicroBatcher(
+                self.cache, self.health, self.metrics, **batcher_kwargs)
         self._http = None
         self._started = False
 
@@ -339,11 +356,17 @@ class SolveService:
         # duration and closes it on exit; once closed, any cache miss
         # is a steady-state recompile and raises under
         # PORQUA_SANITIZE=1 (see ExecutableCache.prewarm).
+        # A continuous service compiles ONLY the continuous triple —
+        # the one-shot solve executables are unreachable from a
+        # ContinuousBatcher and would double prewarm time for nothing.
         n = self.cache.prewarm(bucket, self.batcher.max_batch, dtype,
-                               current)
+                               current, continuous=self.continuous,
+                               include_solve=not self.continuous)
         if self.health.fallback is not current:
             n += self.cache.prewarm(bucket, self.batcher.max_batch,
-                                    dtype, self.health.fallback)
+                                    dtype, self.health.fallback,
+                                    continuous=self.continuous,
+                                    include_solve=not self.continuous)
         # Asymmetry, on purpose: when the breaker is ALREADY open at
         # prewarm time, only the fallback ladder compiles — AOT
         # compilation against a black-holed primary would hang prewarm

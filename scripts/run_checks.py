@@ -5,12 +5,17 @@ Usage:
     python scripts/run_checks.py [paths ...] [options]
 
 Defaults to scanning ``porqua_tpu/`` — every package subtree,
-including the observability stack ``porqua_tpu/obs/`` (which must scan
-clean with zero suppressions, same bar as the solver) — with every AST
-rule (GC001-GC006) plus the trace-time jaxpr contracts (GC101-GC103)
-against the real batch entry points on the XLA-CPU backend, both with
-default solver params and with the convergence-ring telemetry enabled
-(``SolverParams(ring_size>0)``). Exit status: 0 clean, 1 findings,
+including the observability stack ``porqua_tpu/obs/``, the compaction
+driver ``porqua_tpu/compaction.py``, and the continuous batcher
+``porqua_tpu/serve/continuous.py`` (all of which must scan clean with
+zero suppressions, same bar as the solver) — with every AST rule
+(GC001-GC006) plus the trace-time jaxpr contracts (GC101-GC103)
+against the real batch entry points on the XLA-CPU backend: default
+solver params, the convergence-ring telemetry variant
+(``SolverParams(ring_size>0)``), the compaction step-and-repack
+program (dense + factored — the machine-checked proof the repack
+introduces no host syncs/transfers), and the continuous-batching
+admit/step/finalize triple. Exit status: 0 clean, 1 findings,
 2 internal/usage error.
 
 Options:

@@ -67,6 +67,18 @@ def main() -> int:
                          "rings and emit ring events for a sample of "
                          "requests (0 = off, the bit-identical default "
                          "program)")
+    ap.add_argument("--continuous", action="store_true",
+                    help="continuous batching: cohorts step one ADMM "
+                         "segment at a time, retire lanes the boundary "
+                         "they converge, and refill freed slots from "
+                         "the queue (see README 'Batch compaction & "
+                         "continuous batching')")
+    ap.add_argument("--segment-budget", type=int, default=None,
+                    metavar="S",
+                    help="continuous mode: retire any lane after S "
+                         "segments as MAX_ITER + polish fallback "
+                         "(default: the solver's max_iter expressed in "
+                         "segments)")
     ap.add_argument("--seed", type=int, default=5)
     ap.add_argument("--factor", action="store_true",
                     help="carry the low-rank objective factor (Pf = X) "
@@ -89,7 +101,8 @@ def main() -> int:
         max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
         warm_keys=args.warm_keys, deadline_s=args.deadline_s,
         jsonl_path=args.jsonl, trace_out=args.trace_out,
-        events_out=args.events_out, ring_size=args.rings)
+        events_out=args.events_out, ring_size=args.rings,
+        continuous=args.continuous, segment_budget=args.segment_budget)
     report["workload"] = args.workload
     print(json.dumps(report))
     return 0 if report["errors"] == 0 else 1

@@ -1,0 +1,452 @@
+"""Straggler semantics for the segment-compacted batch solve and the
+continuous-batching serve path.
+
+Pins the three contracts the compaction work rests on:
+
+* the steppable ADMM API (``admm_init`` / ``admm_segment_step``) is
+  bit-identical to the fused ``admm_solve`` while_loop;
+* the compacting driver returns bit-identical solutions for converged
+  lanes vs the non-compacting path, retires stragglers at their
+  segment budget as ``MAX_ITER`` (+ polish fallback), and scatter-back
+  preserves lane order;
+* the repack/step programs carry the GC101–103 jaxpr contracts (no
+  host syncs or transfers) and run clean under ``PORQUA_SANITIZE=1``.
+
+Compile-cost discipline: ONE module-scoped driver (prewarmed once —
+the segment budget is a runtime operand, so every budget test reuses
+the same executables) and ONE module-scoped continuous service shared
+by the serve tests.
+"""
+
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from porqua_tpu.compaction import CompactingDriver
+from porqua_tpu.qp.admm import Status, admm_init, admm_segment_step, admm_solve
+from porqua_tpu.qp.canonical import CanonicalQP, stack_qps
+from porqua_tpu.qp.ruiz import equilibrate
+from porqua_tpu.qp.solve import SolverParams, solve_qp_batch
+
+# Tight-eps config so the deliberately ill-conditioned lane genuinely
+# straggles (and exhausts max_iter) while the clean lanes converge in
+# a handful of segments.
+PARAMS = SolverParams(max_iter=1000, eps_abs=1e-7, eps_rel=1e-7,
+                      polish=False, check_interval=25)
+
+N, M, B = 12, 3, 7
+STRAGGLER = 3  # lane index of the ill-conditioned problem
+
+
+def _ill_P(rng, n):
+    """Condition number ~1e6: ADMM's fixed-point rate collapses and
+    the lane runs to max_iter at tight eps."""
+    d = np.logspace(-4, 2, n)
+    Q, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    P = Q @ np.diag(d) @ Q.T
+    return (P + P.T) / 2 + 1e-6 * np.eye(n)
+
+
+def _make_batch():
+    rng = np.random.default_rng(0)
+    qps = []
+    for i in range(B):
+        A = rng.standard_normal((2 * N, N))
+        P = A.T @ A / (2 * N) + np.eye(N)
+        if i == STRAGGLER:
+            P = _ill_P(rng, N)
+        qps.append(CanonicalQP.build(
+            P, rng.standard_normal(N),
+            C=np.concatenate([np.ones((1, N)),
+                              rng.standard_normal((M - 1, N))]),
+            l=np.full(M, -1.0), u=np.ones(M),
+            lb=np.zeros(N), ub=np.ones(N)))
+    return stack_qps(qps)
+
+
+@pytest.fixture(scope="module")
+def batch():
+    return _make_batch()
+
+
+@pytest.fixture(scope="module")
+def fused(batch):
+    """The non-compacting reference solve."""
+    return solve_qp_batch(batch, PARAMS)
+
+
+@pytest.fixture(scope="module")
+def driver(batch):
+    """One prewarmed driver shared by every batch-compaction test (the
+    segment budget is a per-call runtime operand, not an executable
+    fork)."""
+    d = CompactingDriver(PARAMS)
+    compiled = d.prewarm(B, N, M)
+    assert compiled > 0
+    return d
+
+
+# ---------------------------------------------------------------------------
+# steppable API
+# ---------------------------------------------------------------------------
+
+def test_segment_step_matches_admm_solve(batch):
+    """A host loop over jitted admm_segment_step reproduces the fused
+    while_loop bit-for-bit (same compiled segment program)."""
+    qp = jax.tree.map(lambda a: a[0], batch)
+    scaled, scaling = equilibrate(qp, iters=PARAMS.scaling_iters)
+
+    @functools.partial(jax.jit, static_argnames=("params",))
+    def step(carry, s, sc, params):
+        return admm_segment_step(carry, s, sc, params)[0]
+
+    @functools.partial(jax.jit, static_argnames=("params",))
+    def fused_solve(s, sc, params):
+        return admm_solve(s, sc, params)
+
+    carry = jax.jit(lambda q: admm_init(q, PARAMS))(scaled)
+    n_segments = 0
+    while (int(carry.state.status) == Status.RUNNING
+           and int(carry.state.iters) < PARAMS.max_iter):
+        carry = step(carry, scaled, scaling, PARAMS)
+        n_segments += 1
+    assert n_segments >= 1
+    ref = fused_solve(scaled, scaling, PARAMS)
+    got = carry.state._replace(status=jnp.where(
+        carry.state.status == Status.RUNNING, Status.MAX_ITER,
+        carry.state.status).astype(jnp.int32))
+    for name in ("x", "z", "w", "y", "mu", "rho_bar", "iters", "status",
+                 "prim_res", "dual_res"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(got, name)),
+            np.asarray(getattr(ref, name)), err_msg=name)
+
+
+# ---------------------------------------------------------------------------
+# compacting driver
+# ---------------------------------------------------------------------------
+
+def test_compaction_bit_parity_and_lane_order(batch, fused, driver):
+    """(a) + (c): converged lanes bit-identical to the non-compacting
+    path, in the original lane order (scatter-back preserves it), with
+    real lane-segment savings and ladder-only dispatch shapes."""
+    sol, rep = driver.solve(batch)
+    assert rep.compiles == 0, "prewarmed solve must not compile"
+
+    status = np.asarray(fused.status)
+    np.testing.assert_array_equal(status, np.asarray(sol.status))
+    np.testing.assert_array_equal(np.asarray(fused.iters),
+                                  np.asarray(sol.iters))
+    x_ref, x_cmp = np.asarray(fused.x), np.asarray(sol.x)
+    assert status[STRAGGLER] == Status.MAX_ITER  # the tail exists
+    for i in range(B):
+        if status[i] == Status.SOLVED:
+            np.testing.assert_array_equal(x_ref[i], x_cmp[i],
+                                          err_msg=f"lane {i}")
+
+    # Work accounting: the straggler no longer taxes the cohort.
+    assert rep.lane_segments < rep.dense_lane_segments
+    assert rep.savings_vs_dense >= 0.2
+    from porqua_tpu.serve.bucketing import slot_ladder
+
+    rungs = set(slot_ladder(B))
+    assert set(rep.dispatch_sizes) <= rungs
+    assert list(rep.dispatch_sizes) == sorted(rep.dispatch_sizes,
+                                              reverse=True)
+    assert rep.max_iter_lanes == int(np.sum(status == Status.MAX_ITER))
+
+
+def test_compaction_off_matches_dense_accounting(batch, fused, driver):
+    """compact=False steps full width every boundary: executed ==
+    batch x max-segments, and results still match the fused path."""
+    sol, rep = driver.solve(batch, compact=False)
+    assert rep.lane_segments == rep.dense_lane_segments
+    assert set(rep.dispatch_sizes) == {B}
+    np.testing.assert_array_equal(np.asarray(fused.iters),
+                                  np.asarray(sol.iters))
+
+
+def test_straggler_retires_at_segment_budget(batch, driver):
+    """(b): with a per-lane budget the straggler retires as MAX_ITER at
+    exactly budget segments — bit-identical to the fused path run with
+    the equivalent max_iter — and the clean lanes are untouched."""
+    budget = 16  # = 400 iterations; the clean lanes need <= 375
+    sol, rep = driver.solve(batch, segment_budget=budget)
+    assert rep.compiles == 0  # budget is a runtime operand, no fork
+    status = np.asarray(sol.status)
+    iters = np.asarray(sol.iters)
+    assert status[STRAGGLER] == Status.MAX_ITER
+    assert iters[STRAGGLER] == budget * PARAMS.check_interval
+    assert rep.max_iter_lanes == 1
+
+    # Budget semantics == the fused solve with max_iter = budget * ci.
+    import dataclasses
+
+    capped = dataclasses.replace(
+        PARAMS, max_iter=budget * PARAMS.check_interval)
+    ref = solve_qp_batch(batch, capped)
+    np.testing.assert_array_equal(np.asarray(ref.status), status)
+    for i in range(B):
+        np.testing.assert_array_equal(np.asarray(ref.x)[i],
+                                      np.asarray(sol.x)[i],
+                                      err_msg=f"lane {i}")
+
+
+def test_budget_retirement_gets_polish_fallback(batch):
+    """A lane retired out of budget still gets the active-set polish —
+    and is re-graded SOLVED when the polished point meets tolerance
+    (the 'MAX_ITER + polish fallback' path)."""
+    import dataclasses
+
+    loose = dataclasses.replace(PARAMS, eps_abs=1e-5, eps_rel=1e-5,
+                                polish=True)
+    d = CompactingDriver(loose, segment_budget=2)
+    sol, rep = d.solve(batch)
+    status = np.asarray(sol.status)
+    x = np.asarray(sol.x)
+    assert np.all(np.isfinite(x))
+    # Every lane was cut off at 50 iterations; the polish rescues the
+    # well-conditioned ones to SOLVED, and whatever stays MAX_ITER
+    # still carries a finite polished iterate + residuals.
+    assert np.all((status == Status.SOLVED) | (status == Status.MAX_ITER))
+    assert int(np.sum(status == Status.SOLVED)) >= B - 1
+    assert np.all(np.asarray(sol.iters) <= 2 * PARAMS.check_interval)
+
+
+def test_solve_batch_compacted_wrapper(batch, driver):
+    from porqua_tpu.batch import BatchProblems, solve_batch_compacted
+
+    problems = BatchProblems(
+        qp=batch, rebdates=[str(i) for i in range(B)],
+        universes=[[f"a{j}" for j in range(N)]] * B, n_assets_max=N)
+    sol, rep = solve_batch_compacted(problems, PARAMS, driver=driver)
+    assert rep.batch == B
+    assert int(np.sum(np.asarray(sol.status) == Status.SOLVED)) == B - 1
+
+
+# ---------------------------------------------------------------------------
+# contracts + sanitizer
+# ---------------------------------------------------------------------------
+
+def test_repack_jaxpr_contracts():
+    """The step+repack program (and the continuous triple) is free of
+    host callbacks/transfers and dtype leaks — GC101-103 traced on the
+    exact code the driver compiles."""
+    from porqua_tpu.analysis.contracts import (
+        check_closed_jaxpr, compaction_step_jaxpr, continuous_jaxprs)
+
+    findings = check_closed_jaxpr(
+        compaction_step_jaxpr(batch=4, group=2, n=8, m=2),
+        "compaction_step")
+    for label, jaxpr in continuous_jaxprs(batch=2, n=8, m=2):
+        findings += check_closed_jaxpr(jaxpr, label)
+    assert findings == []
+
+
+def test_repack_sanitized_no_implicit_transfers(batch, driver,
+                                                monkeypatch):
+    """PORQUA_SANITIZE=1: the whole compacted solve loop runs inside
+    jax.transfer_guard('disallow') — the repack path performs no
+    implicit h2d/d2h transfers (control readouts are explicit
+    device_get) — and a prewarmed solve demands no compiles."""
+    from porqua_tpu.analysis import sanitize
+
+    dev_batch = jax.device_put(batch)
+    monkeypatch.setenv("PORQUA_SANITIZE", "1")
+    assert sanitize.enabled()
+    sol, rep = driver.solve(dev_batch, segment_budget=4)
+    assert rep.compiles == 0
+    assert np.all(np.isfinite(np.asarray(sol.x)))
+
+
+# ---------------------------------------------------------------------------
+# continuous batching in serve
+# ---------------------------------------------------------------------------
+
+SERVE_PARAMS = SolverParams(max_iter=500, eps_abs=1e-5, eps_rel=1e-5,
+                            polish=False, check_interval=25)
+SERVE_BUDGET = 6  # 150 iterations: plenty for the clean lanes, far
+#                   short of the ill-conditioned lane's requirement
+
+
+def _serve_qp(n=6, m=2, seed=0, ill=False):
+    rng = np.random.default_rng(seed)
+    A = rng.standard_normal((2 * n, n))
+    P = _ill_P(rng, n) if ill else A.T @ A / (2 * n) + np.eye(n)
+    q = rng.standard_normal(n)
+    C = np.concatenate([np.ones((1, n)),
+                        rng.standard_normal((m - 1, n))])
+    return CanonicalQP.build(P, q, C=C, l=np.full(m, -1.0), u=np.ones(m),
+                             lb=np.zeros(n), ub=np.ones(n))
+
+
+@pytest.fixture(scope="module")
+def service():
+    """One started+prewarmed continuous service shared by the serve
+    tests (the per-lane budget is batcher state, so tests that need
+    retirement use the ill-conditioned problem against SERVE_BUDGET).
+    Each test calls ``metrics.reset_window()`` for its own counters."""
+    from porqua_tpu.serve import BucketLadder, SolveService
+
+    svc = SolveService(params=SERVE_PARAMS,
+                       ladder=BucketLadder(n_rungs=(8, 16),
+                                           m_rungs=(4, 8)),
+                       max_batch=4, max_wait_ms=2.0,
+                       continuous=True, segment_budget=SERVE_BUDGET)
+    svc.start()
+    svc.prewarm(_serve_qp())
+    yield svc
+    svc.stop()
+
+
+def test_continuous_stream_solves_and_refills(service):
+    """More requests than cohort slots: freed slots refill from the
+    queue (continuous batching), every request resolves with its
+    per-lane Status, and the segment counters populate."""
+    from porqua_tpu.qp.solve import solve_qp
+
+    service.metrics.reset_window()
+    tickets = [service.submit(_serve_qp(seed=i), warm_key=str(i))
+               for i in range(10)]
+    results = [service.result(t, timeout=120) for t in tickets]
+    assert all(r.found for r in results)
+    assert all(r.status == Status.SOLVED for r in results)
+    snap = service.snapshot()
+    assert snap["lanes_admitted"] == 10
+    assert snap["completed"] == 10
+    assert snap["status_solved"] == 10
+    assert snap["lane_segments"] > 0
+    assert 0.0 < snap["segment_occupancy_mean"] <= 1.0
+    assert snap["compiles"] == 0  # prewarm covered the whole ladder
+    # Result parity with the one-shot solver (same params, same
+    # bucket-padded problem class).
+    ref = solve_qp(_serve_qp(seed=3), SERVE_PARAMS)
+    np.testing.assert_allclose(results[3].x, np.asarray(ref.x)[:6],
+                               atol=1e-5)
+
+
+def test_continuous_segment_budget_retires_max_iter(service):
+    """An ill-conditioned request at the cohort's segment budget
+    retires as MAX_ITER (polish off, so nothing rescues it) while a
+    clean cohort mate still solves — the straggler stops taxing cohort
+    latency and is distinguishable at the API boundary."""
+    service.metrics.reset_window()
+    t_bad = service.submit(_serve_qp(seed=1, ill=True))
+    t_ok = service.submit(_serve_qp(seed=2))
+    bad = service.result(t_bad, timeout=120)
+    ok = service.result(t_ok, timeout=120)
+    assert bad.status == Status.MAX_ITER and not bad.found
+    assert bad.iters == SERVE_BUDGET * SERVE_PARAMS.check_interval
+    assert ok.status == Status.SOLVED
+    snap = service.snapshot()
+    assert snap["lanes_retired_budget"] >= 1
+    assert snap["status_max_iter"] >= 1
+    assert snap["status_solved"] >= 1
+
+
+def test_continuous_warm_start_cache_round_trip(service):
+    """A repeat rebalance under the same warm_key warm-starts in the
+    continuous path too."""
+    first = service.result(
+        service.submit(_serve_qp(seed=5), warm_key="book"), timeout=120)
+    second = service.result(
+        service.submit(_serve_qp(seed=5), warm_key="book"), timeout=120)
+    assert not first.warm_started
+    assert second.warm_started
+    assert second.iters <= first.iters
+
+
+def test_continuous_budget_clamped_to_max_iter_semantics():
+    """A requested budget wider than ceil(max_iter/check_interval) is
+    clamped: the continuous step program has no max_iter brake of its
+    own, so the clamp is what keeps serve retirement identical to the
+    compaction driver's lane_active policy."""
+    from porqua_tpu.qp.solve import default_segment_budget
+    from porqua_tpu.serve import BucketLadder, SolveService
+
+    svc = SolveService(params=SERVE_PARAMS,
+                       ladder=BucketLadder(n_rungs=(8,), m_rungs=(4,)),
+                       max_batch=4, continuous=True, segment_budget=999)
+    assert svc.batcher.segment_budget == default_segment_budget(
+        SERVE_PARAMS)  # = 500/25 = 20, not 999
+
+
+def test_continuous_cohort_replaced_when_queue_outgrows_it(service):
+    """A cohort minted from the first trickle of a ramping stream must
+    not permanently cap the bucket's throughput: when the queue
+    outgrows it, it stops refilling, drains, and a larger replacement
+    is sized from the backlog. (White-box: drives the batcher's tick
+    directly so the policy is deterministic — the live thread in the
+    shared service is quiesced by using a separate, unstarted one.)"""
+    import collections
+    import time
+    from concurrent.futures import Future
+
+    from porqua_tpu.serve import BucketLadder, SolveService
+    from porqua_tpu.serve.batcher import SolveRequest
+
+    svc = SolveService(params=SERVE_PARAMS,
+                       ladder=BucketLadder(n_rungs=(8, 16),
+                                           m_rungs=(4, 8)),
+                       max_batch=8, continuous=True)
+    # Executables come from the shared module service's prewarmed
+    # ladder? No — caches are per service; prewarm this one (slots 2
+    # and 8 are both ladder rungs).
+    svc.prewarm(_serve_qp())
+    b = svc.batcher
+
+    def req(seed):
+        qp0 = _serve_qp(seed=seed)
+        bk, pd = svc.ladder.pad(qp0)
+        return bk, SolveRequest(qp=pd, bucket=bk, n_orig=qp0.n,
+                                m_orig=qp0.m, future=Future(),
+                                submitted=time.monotonic())
+
+    bucket, r0 = req(0)
+    _, r1 = req(1)
+    dq = collections.deque([r0, r1])
+    b._pending[bucket] = dq
+    b._make_cohort_safe(bucket, dq)
+    cohort = b._cohorts[bucket]
+    assert cohort.slots == 2
+    b._tick(bucket, cohort)  # admits + first segment for the two
+    assert not cohort.no_refill
+
+    dq.extend(req(i)[1] for i in range(2, 14))
+    for _ in range(60):
+        b._tick(bucket, cohort)
+        if cohort.empty():
+            break
+    assert cohort.no_refill  # the backlog outgrew the cohort
+    assert cohort.empty()    # in-flight lanes finished normally
+    assert r0.future.done() and r1.future.done()
+    assert r0.future.result().found
+    assert len(dq) == 12     # backlog untouched by the draining cohort
+    assert svc.metrics.counters["cohort_replacements"] >= 1
+
+    # The replacement is sized from the backlog, not the old cohort.
+    del b._cohorts[bucket]
+    b._make_cohort_safe(bucket, dq)
+    assert b._cohorts[bucket].slots == 8
+    for r in dq:
+        r.future.cancel()
+
+
+def test_loadgen_continuous_reports_status_counts():
+    """The loadgen report surfaces per-lane Status counts and the
+    segment-occupancy metrics for a continuous run."""
+    from porqua_tpu.serve.loadgen import build_tracking_requests, run_loadgen
+
+    requests = build_tracking_requests(6, n_assets=8, window=16)
+    report = run_loadgen(requests, params=SERVE_PARAMS, max_batch=2,
+                         continuous=True)
+    assert report["continuous"] is True
+    assert report["recompiles_after_warmup"] == 0
+    assert sum(report["status_counts"].values()) == 6
+    assert report["status_counts"].get("solved", 0) == 6
+    assert report["lane_segments"] > 0
+    assert 0.0 <= report["wasted_lane_fraction"] < 1.0
