@@ -42,6 +42,7 @@ except ImportError:  # pragma: no cover - version dependent
 __all__ = [
     "check_closed_jaxpr",
     "check_entry_points",
+    "check_resilience_identity",
     "check_run_batch",
     "compaction_step_jaxpr",
     "continuous_jaxprs",
@@ -241,6 +242,78 @@ def continuous_jaxprs(batch: int = 4, n: int = 16, m: int = 4,
     ]
 
 
+def check_resilience_identity(dtype=np.float32) -> List[Finding]:
+    """GC104: fault injection must be invisible to XLA.
+
+    The resilience plane (:mod:`porqua_tpu.resilience`) promises its
+    seams live strictly in host dispatch code — with the injector
+    disabled the solve/serve programs are the pre-resilience ones,
+    bit for bit. Source review can't prove that (a seam smuggled into
+    a traced function behind ``jax.debug.callback`` or a trace-time
+    host branch would *look* guarded); the jaxpr can. This check
+    traces the solve-batch and serve entry points twice — once bare,
+    once with a live injector installed whose scenario covers **every
+    seam and fault kind** — and requires the two jaxprs to be
+    string-identical. Any seam reachable from tracing would fire
+    (raising kinds abort the trace, directive kinds perturb it), so
+    identity is exactly the "no new primitives, no callbacks,
+    bit-identical when disabled" contract, machine-checked.
+
+    Requires no injector to be active (it installs its own); an
+    installed one raises, which ``run_checks.py`` surfaces as an
+    internal error rather than a clean pass.
+    """
+    from porqua_tpu.resilience import faults
+
+    def trace_all():
+        return [("solve_batch", str(solve_batch_jaxpr(dtype=dtype))),
+                ("serve_entry", str(serve_entry_jaxpr(dtype=dtype)))]
+
+    findings: List[Finding] = []
+    baseline = trace_all()
+    mk = faults.FaultSpec.make
+    scenario = faults.Scenario("gc104-contract", (
+        mk("serve.dispatch", "device_lost", count=1_000_000),
+        mk("serve.continuous", "device_lost", count=1_000_000),
+        mk("serve.result", "nan_lanes", count=1_000_000, lanes=1),
+        mk("serve.admission", "clock_skew", count=1_000_000, skew_s=1.0),
+        mk("health.probe", "probe_fail", count=1_000_000),
+        mk("cache.get", "compile_storm", count=1_000_000),
+        mk("data.feed", "feed_corrupt", count=1_000_000),
+        mk("backtest.chunk", "crash", count=1_000_000),
+    ))
+    # Install OUTSIDE the trace try-block: a pre-installed injector is
+    # a usage error (install raises RuntimeError) and must propagate as
+    # such, not be misreported as a seam reachable from tracing.
+    inj = faults.install(faults.FaultInjector(scenario))
+    try:
+        try:
+            injected = trace_all()
+            fired = inj.fires()
+        except BaseException as exc:  # noqa: BLE001 - seam fired mid-trace
+            return [Finding(
+                "GC104", "<jaxpr:resilience_identity>", 0, 0,
+                f"tracing with a live injector raised "
+                f"{type(exc).__name__}: {exc} — a fault seam is "
+                f"reachable from a traced program")]
+    finally:
+        faults.uninstall()
+    if fired:
+        findings.append(Finding(
+            "GC104", "<jaxpr:resilience_identity>", 0, 0,
+            f"{fired} fault seam hit(s) fired during tracing — seams "
+            "must live strictly in host dispatch code"))
+    for (label, base), (_, inj_str) in zip(baseline, injected):
+        if base != inj_str:
+            findings.append(Finding(
+                "GC104", f"<jaxpr:{label}>", 0, 0,
+                "traced program differs with a fault injector "
+                "installed: the injector-disabled program is no longer "
+                "the pre-resilience one (bit-identical-when-disabled "
+                "contract broken)"))
+    return findings
+
+
 def run_batch_jaxpr(bs, params=None, dtype=np.float32) -> ClosedJaxpr:
     """Trace ``run_batch``'s device core against a *real*
     ``BacktestService``: the host pass (``build_problems``) runs for
@@ -313,4 +386,9 @@ def check_entry_points(dtype=np.float32,
         "compaction_step[factored]", expect_float=dtype)
     for label, jaxpr in continuous_jaxprs(dtype=dtype):
         findings += check_closed_jaxpr(jaxpr, label, expect_float=dtype)
+    # GC104: the fault-injection plane must be invisible to XLA — the
+    # solve/serve jaxprs with an injector installed are required to be
+    # string-identical to the bare ones (no new primitives, no
+    # callbacks, bit-identical when disabled).
+    findings += check_resilience_identity(dtype=dtype)
     return findings

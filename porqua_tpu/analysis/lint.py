@@ -45,8 +45,14 @@ GC005  Module-level calls that initialize a JAX backend at import time:
        scope stay exempt — they are lazy and are the *recommended*
        caching pattern.
 
+GC007   Fault-injection seam (``porqua_tpu.resilience.faults.fire``)
+        not lexically inside an ``if faults.enabled():`` guard — the
+        pattern that keeps the disabled production path one
+        module-global predicate and provably bit-identical (see the
+        GC104 jaxpr-identity contract).
+
 GC006 (the ``# guarded-by:`` thread-safety lint) lives in
-:mod:`porqua_tpu.analysis.guards`; GC101-GC103 (trace-time jaxpr
+:mod:`porqua_tpu.analysis.guards`; GC101-GC104 (trace-time jaxpr
 contracts) live in :mod:`porqua_tpu.analysis.contracts`. This module's
 own code is pure stdlib ``ast`` — it adds no JAX work of its own,
 though reaching it through the package path still executes
@@ -77,9 +83,11 @@ RULE_DOCS = {
     "GC004": "stray debug hook in library code",
     "GC005": "backend-initializing work at module import time",
     "GC006": "guarded-by attribute mutated without its lock",
+    "GC007": "fault seam not guarded by the injector-enabled check",
     "GC101": "float64 leaked into a traced program",
     "GC102": "callback/transfer primitive inside a traced program",
     "GC103": "unstable output dtype in a traced program",
+    "GC104": "fault injection perturbs a traced program",
 }
 
 _CONTRACTIONS = {"dot", "einsum", "matmul", "tensordot", "inner", "vdot"}
@@ -690,6 +698,103 @@ def _check_gc004(mod: ModuleInfo) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# GC007 — fault seams guarded by the injector-enabled predicate
+# ---------------------------------------------------------------------------
+
+def _faults_bindings(mod: ModuleInfo) -> Tuple[Set[str], Set[str], Set[str]]:
+    """Names bound to the fault-injection module / its entry points:
+    ``(module_aliases, bare_fire_names, bare_enabled_names)`` —
+    covering ``import porqua_tpu.resilience.faults as _faults``,
+    ``from porqua_tpu.resilience import faults``, and
+    ``from porqua_tpu.resilience.faults import fire, enabled``."""
+    mod_aliases: Set[str] = set()
+    for alias, target in mod.module_aliases.items():
+        if target.endswith("resilience.faults"):
+            mod_aliases.add(alias)
+    bare_fire: Set[str] = set()
+    bare_enabled: Set[str] = set()
+    for alias, (src, orig) in mod.imported_from.items():
+        if orig == "faults" and src.endswith("resilience"):
+            mod_aliases.add(alias)
+        elif src.endswith("resilience.faults"):
+            if orig == "fire":
+                bare_fire.add(alias)
+            elif orig == "enabled":
+                bare_enabled.add(alias)
+    return mod_aliases, bare_fire, bare_enabled
+
+
+def _check_gc007(mod: ModuleInfo) -> List[Finding]:
+    """Every ``faults.fire(...)`` seam must sit lexically inside an
+    ``if`` whose test calls ``faults.enabled()``. The guard is what
+    makes the disabled path one module-global predicate (no injector
+    lookup, no RNG, no allocation) — an unguarded seam silently turns
+    the production hot path into a per-call function boundary AND
+    breaks the bit-identical-when-disabled promise the chaos suite's
+    A/B leans on. The resilience package itself is exempt (it IS the
+    plane), as are tests/scripts/examples."""
+    if not in_library_scope(mod.posix) or "/resilience/" in mod.posix:
+        return []
+    mod_aliases, bare_fire, bare_enabled = _faults_bindings(mod)
+    if not mod_aliases and not bare_fire:
+        return []
+
+    def is_enabled_call(node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        chain = mod.attr_chain(node.func)
+        if not chain:
+            return False
+        if len(chain) == 1 and chain[0] in bare_enabled:
+            return True
+        return (len(chain) == 2 and chain[0] in mod_aliases
+                and chain[1] == "enabled")
+
+    def positively_tests_enabled(test: ast.AST) -> bool:
+        # enabled() must appear in the test OUTSIDE any `not`:
+        # `if not faults.enabled():` selects exactly the disabled path
+        # the rule exists to keep seam-free.
+        negated: Set[ast.AST] = set()
+        for sub in ast.walk(test):
+            if isinstance(sub, ast.UnaryOp) and isinstance(sub.op, ast.Not):
+                negated.update(ast.walk(sub.operand))
+        return any(is_enabled_call(sub) and sub not in negated
+                   for sub in ast.walk(test))
+
+    def guarded(node: ast.AST) -> bool:
+        # The fire() must sit in the If's BODY (not the orelse — a
+        # seam in the else branch of an enabled() check is precisely
+        # the unguarded/disabled-path placement being linted for).
+        child: ast.AST = node
+        for anc in _ancestors(node):
+            if (isinstance(anc, ast.If) and child in anc.body
+                    and positively_tests_enabled(anc.test)):
+                return True
+            child = anc
+        return False
+
+    out: List[Finding] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = mod.attr_chain(node.func)
+        if not chain:
+            continue
+        is_fire = ((len(chain) == 1 and chain[0] in bare_fire)
+                   or (len(chain) == 2 and chain[0] in mod_aliases
+                       and chain[1] == "fire"))
+        if not is_fire or guarded(node):
+            continue
+        if not mod.suppressed("GC007", node.lineno):
+            out.append(Finding(
+                "GC007", mod.path, node.lineno, node.col_offset,
+                "fault seam fired without the enabled() guard; wrap in "
+                "`if faults.enabled():` so the disabled path stays one "
+                "module-global predicate (and bit-identical)"))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # GC005 — backend init at import time
 # ---------------------------------------------------------------------------
 
@@ -819,6 +924,8 @@ def scan_paths(paths: Sequence[str],
             findings.extend(_check_gc004(mod))
         if want("GC005"):
             findings.extend(_check_gc005(mod))
+        if want("GC007"):
+            findings.extend(_check_gc007(mod))
     if want("GC002"):
         findings.extend(_check_gc002(mods, reached))
     if want("GC006"):

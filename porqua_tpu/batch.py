@@ -312,11 +312,21 @@ def solve_scan_l1(qp: CanonicalQP,
 
 
 def _scan_l1_core(qp: CanonicalQP, w0, l1w,
-                  params: SolverParams) -> QPSolution:
+                  params: SolverParams,
+                  x_init=None, y_init=None,
+                  return_carry: bool = False):
     """One column of the chained-L1 backtest: the single scan body
     shared by :func:`solve_scan_l1` and (vmapped) by
     :func:`solve_scan_l1_grid`, so the carry/failed-date semantics
-    cannot drift between the two."""
+    cannot drift between the two.
+
+    ``x_init``/``y_init`` seed the warm-start half of the carry
+    (default zeros — a cold start) and ``return_carry=True`` also
+    returns the final ``(w, x, y)`` carry: together they let
+    :func:`porqua_tpu.checkpoint.solve_scan_l1_checkpointed` cut the
+    date axis into segments whose chained execution is bit-identical
+    to one uncut scan (the scan body is the same compiled program
+    either way; only the host loop around it changes)."""
     dtype = qp.P.dtype
     nvar, m = qp.P.shape[-1], qp.C.shape[-2]
 
@@ -331,8 +341,16 @@ def _scan_l1_core(qp: CanonicalQP, w0, l1w,
         w_carry = jnp.where(ok, sol.x, w_prev)
         return (w_carry, sol.x, sol.y), sol
 
-    init = (w0, jnp.zeros(nvar, dtype), jnp.zeros(m, dtype))
-    _, sols = jax.lax.scan(step, init, qp)
+    init = (
+        w0,
+        jnp.zeros(nvar, dtype) if x_init is None
+        else jnp.asarray(x_init, dtype),
+        jnp.zeros(m, dtype) if y_init is None
+        else jnp.asarray(y_init, dtype),
+    )
+    carry, sols = jax.lax.scan(step, init, qp)
+    if return_carry:
+        return sols, carry
     return sols
 
 

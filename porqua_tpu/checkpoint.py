@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from porqua_tpu.qp.solve import QPSolution, SolverParams
+from porqua_tpu.resilience import faults as _faults
 
 _SOLUTION_FIELDS = list(QPSolution._fields)
 
@@ -76,22 +77,28 @@ class CheckpointManager:
     params_key: str
 
     @staticmethod
-    def _key(params: SolverParams, dtype=None, has_l1: bool = False) -> str:
+    def _key(params: SolverParams, dtype=None, has_l1: bool = False,
+             extra: Optional[dict] = None) -> str:
         # dtype and the l1 configuration change the numerical content of
         # a chunk, so they are part of the run identity — resuming with a
         # different dtype must not silently mix f32 and f64 chunks.
+        # `extra` folds in caller-level identity (e.g. the scan
+        # backtest's transaction cost and initial holdings hash).
         key = dataclasses.asdict(params)
         key["dtype"] = str(jnp.dtype(dtype)) if dtype is not None else None
         key["has_l1"] = bool(has_l1)
+        if extra:
+            key["extra"] = {k: extra[k] for k in sorted(extra)}
         return json.dumps(key, sort_keys=True)
 
     @classmethod
     def create(cls, directory: str, rebdates: List[str], chunk_size: int,
                params: SolverParams, dtype=None,
-               has_l1: bool = False) -> "CheckpointManager":
+               has_l1: bool = False,
+               extra: Optional[dict] = None) -> "CheckpointManager":
         os.makedirs(directory, exist_ok=True)
         mgr = cls(directory, [str(d) for d in rebdates], int(chunk_size),
-                  cls._key(params, dtype, has_l1))
+                  cls._key(params, dtype, has_l1, extra))
         manifest_path = os.path.join(directory, "manifest.json")
         manifest = {
             "rebdates": mgr.rebdates,
@@ -119,10 +126,20 @@ class CheckpointManager:
     def chunk_path(self, idx: int) -> str:
         return os.path.join(self.directory, f"chunk_{idx:04d}.npz")
 
-    def completed_chunks(self) -> int:
-        """Number of leading chunks already on disk (gap == stop)."""
+    def carry_path(self, idx: int) -> str:
+        return os.path.join(self.directory, f"carry_{idx:04d}.npz")
+
+    def completed_chunks(self, require_carry: bool = False) -> int:
+        """Number of leading chunks already on disk (gap == stop).
+        ``require_carry=True`` counts a chunk complete only when its
+        carry file exists too — the scan-coupled resume needs the
+        exact boundary state, so a crash BETWEEN the chunk write and
+        the carry write rolls that chunk back rather than resuming
+        from an unreconstructable point."""
         done = 0
         while done < self.n_chunks and os.path.exists(self.chunk_path(done)):
+            if require_carry and not os.path.exists(self.carry_path(done)):
+                break
             done += 1
         return done
 
@@ -132,6 +149,18 @@ class CheckpointManager:
         tmp = self.chunk_path(idx) + ".tmp.npz"
         save_solution(tmp, sol)
         os.replace(tmp, self.chunk_path(idx))
+
+    def save_carry(self, idx: int, carry: dict) -> None:
+        """Persist one segment boundary's scan carry (named arrays),
+        with the same write-then-rename crash discipline as chunks."""
+        tmp = self.carry_path(idx) + ".tmp.npz"
+        np.savez_compressed(tmp, **{k: np.asarray(v)
+                                    for k, v in carry.items()})
+        os.replace(tmp, self.carry_path(idx))
+
+    def load_carry(self, idx: int) -> dict:
+        with np.load(self.carry_path(idx)) as data:
+            return {k: np.array(data[k]) for k in data.files}
 
     def load_all(self, upto: Optional[int] = None) -> Optional[QPSolution]:
         upto = self.completed_chunks() if upto is None else upto
@@ -198,6 +227,11 @@ def run_batch_checkpointed(bs,
         l1c = None if problems.l1_center is None else problems.l1_center[lo:hi]
         sol = solve_qp_batch(qp_chunk, params, x0, y0, l1w, l1c)
         mgr.save_chunk(idx, sol)
+        if _faults.enabled():
+            # backtest.chunk seam: an injected crash kills the run
+            # right after this chunk persisted — the crash-resume
+            # tests' deterministic stand-in for a mid-backtest SIGKILL.
+            _faults.fire("backtest.chunk", idx=idx)
         sols.append(sol)
         warm_x, warm_y = sol.x[-1], sol.y[-1]
 
@@ -209,3 +243,106 @@ def run_batch_checkpointed(bs,
         "total_chunks": mgr.n_chunks,
     }
     return backtest
+
+
+def solve_scan_l1_checkpointed(qp,
+                               n_assets: int,
+                               w_init,
+                               transaction_cost: float,
+                               directory: str,
+                               params: SolverParams = SolverParams(),
+                               segment_size: int = 64,
+                               *,
+                               universes):
+    """:func:`porqua_tpu.batch.solve_scan_l1` with crash-resume — the
+    rolling-rebalance scan checkpointing its carry at segment
+    boundaries.
+
+    The turnover-cost backtest chains every date through the scan
+    carry ``(w_prev, x_prev, y_prev)``, so the warm-start trick
+    :func:`run_batch_checkpointed` uses for independent dates is not
+    available: resuming mid-stream requires the EXACT boundary state.
+    This runner cuts the date axis into ``segment_size`` segments,
+    runs each as one ``lax.scan`` seeded with the previous boundary's
+    carry, and persists both the segment's solutions and the boundary
+    carry (write-then-rename; a segment only counts complete when its
+    carry landed too). Because a split scan executes the identical
+    per-date step program on identical values, a run killed at ANY
+    boundary and resumed produces **bit-identical** results to an
+    uninterrupted run — the parity the crash-resume tests pin with
+    exact array equality.
+
+    Returns ``(QPSolution, info)`` where ``info`` carries
+    ``resumed_segments`` / ``total_segments`` / ``directory``.
+    ``universes`` is the same non-optional positional-carry
+    attestation as the underlying scan entry points.
+    """
+    import jax
+
+    from porqua_tpu.batch import _require_fixed_universe, _scan_l1_core
+
+    _require_fixed_universe(universes)
+    dtype = qp.P.dtype
+    T, nvar = qp.P.shape[0], qp.P.shape[-1]
+    m = qp.C.shape[-2]
+    tc = jnp.asarray(transaction_cost, dtype)
+    l1w = jnp.where(jnp.arange(nvar) < n_assets, tc,
+                    jnp.asarray(0.0, dtype))
+    w0 = jnp.zeros(nvar, dtype).at[:n_assets].set(
+        jnp.asarray(w_init, dtype)[:n_assets])
+
+    mgr = CheckpointManager.create(
+        directory, [str(i) for i in range(T)], segment_size, params,
+        dtype=dtype, has_l1=True,
+        extra={
+            "kind": "scan_l1",
+            "transaction_cost": float(transaction_cost),
+            "n_assets": int(n_assets),
+            # The initial holdings are run identity too: resuming a
+            # cash-start run with different w_init would silently
+            # chain costs from the wrong book.
+            "w_init_sha": _array_fingerprint(w0),
+        })
+
+    start = mgr.completed_chunks(require_carry=True)
+    sols: List[QPSolution] = []
+    if start:
+        sols.append(mgr.load_all(start))
+        boundary = mgr.load_carry(start - 1)
+        carry_w = jnp.asarray(boundary["w"], dtype)
+        carry_x = jnp.asarray(boundary["x"], dtype)
+        carry_y = jnp.asarray(boundary["y"], dtype)
+    else:
+        carry_w = w0
+        carry_x = jnp.zeros(nvar, dtype)
+        carry_y = jnp.zeros(m, dtype)
+
+    for idx in range(start, mgr.n_chunks):
+        lo = idx * mgr.chunk_size
+        hi = min(lo + mgr.chunk_size, T)
+        qp_seg = jax.tree.map(lambda a: a[lo:hi], qp)
+        sol, (carry_w, carry_x, carry_y) = _scan_l1_core(
+            qp_seg, carry_w, l1w, params,
+            x_init=carry_x, y_init=carry_y, return_carry=True)
+        mgr.save_chunk(idx, sol)
+        mgr.save_carry(idx, {"w": carry_w, "x": carry_x, "y": carry_y})
+        if _faults.enabled():
+            # backtest.chunk seam: the induced SIGKILL for the
+            # bit-parity tests fires AFTER the boundary persisted —
+            # the worst crash point a clean resume must cover.
+            _faults.fire("backtest.chunk", idx=idx)
+        sols.append(sol)
+
+    solution = _concat_solutions(sols) if len(sols) > 1 else sols[0]
+    return solution, {
+        "directory": directory,
+        "resumed_segments": start,
+        "total_segments": mgr.n_chunks,
+    }
+
+
+def _array_fingerprint(a) -> str:
+    import hashlib
+
+    arr = np.ascontiguousarray(np.asarray(a))
+    return hashlib.blake2b(arr.tobytes(), digest_size=8).hexdigest()

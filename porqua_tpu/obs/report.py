@@ -192,6 +192,59 @@ def convergence_section(events: Sequence[Dict[str, Any]],
     return "\n".join(lines)
 
 
+#: Event kinds the faults/recovery section treats as recovery-side
+#: (emitted by porqua_tpu.resilience.retry and the breaker), keyed to
+#: the injected faults they answer.
+_RECOVERY_KINDS = ("retry_scheduled", "retry_giveup", "hedge_fired",
+                   "validation_failed", "breaker_open", "breaker_close",
+                   "probe_failure", "dispatch_failure")
+
+
+def faults_section(events: Sequence[Dict[str, Any]],
+                   max_shown: int = 8) -> str:
+    """Faults vs recovery, from the event log: what the injector (or
+    the real world) did, per seam and kind, next to what the recovery
+    machinery did about it — the at-a-glance answer to "did the chaos
+    scenario exercise the paths it claimed to" (the invariant-level
+    verdicts live in ``scripts/chaos_suite.py``'s JSON report)."""
+    injected = [e for e in events if e.get("kind") == "fault_injected"]
+    recovery: Dict[str, int] = {}
+    for e in events:
+        k = e.get("kind")
+        if k in _RECOVERY_KINDS:
+            recovery[k] = recovery.get(k, 0) + 1
+    if not injected and not recovery:
+        return "faults / recovery: (no fault or recovery events)"
+    lines = ["faults / recovery"]
+    by_fault: Dict[Tuple[str, str], int] = {}
+    for e in injected:
+        key = (e.get("seam", "?"), e.get("fault_kind", "?"))
+        by_fault[key] = by_fault.get(key, 0) + 1
+    scenarios = sorted({e.get("scenario") for e in injected
+                        if e.get("scenario")})
+    if scenarios:
+        lines.append(f"  scenario(s): {', '.join(scenarios)}")
+    for (seam, kind), n in sorted(by_fault.items()):
+        lines.append(f"  injected {seam:<18} {kind:<14} x{n}")
+    for kind in _RECOVERY_KINDS:
+        if kind in recovery:
+            lines.append(f"  recovery {kind:<24} x{recovery[kind]}")
+    # A breaker that opened and never re-closed is the one line an
+    # operator must not miss.
+    opens = recovery.get("breaker_open", 0)
+    closes = recovery.get("breaker_close", 0)
+    if opens or closes:
+        state = ("re-closed" if closes >= opens else
+                 "STILL OPEN (degraded)")
+        lines.append(f"  breaker: {opens} open / {closes} close -> {state}")
+    giveups = [e for e in events if e.get("kind") == "retry_giveup"]
+    for e in giveups[-max_shown:]:
+        detail = {k: v for k, v in e.items()
+                  if k not in ("t", "kind", "severity")}
+        lines.append(f"  ! giveup {detail}")
+    return "\n".join(lines)
+
+
 def events_section(events: Sequence[Dict[str, Any]],
                    max_shown: int = 12) -> str:
     """Severity rollup + the most recent warn/error lines."""
@@ -223,6 +276,7 @@ def render_report(trace: Any = None,
         sections.append(waterfall_section(trace))
     if events is not None:
         sections.append(convergence_section(events))
+        sections.append(faults_section(events))
         sections.append(events_section(events))
     if not sections:
         return "obs_report: no artifacts given (need --trace/--events/--metrics)"

@@ -1,0 +1,67 @@
+"""Resilience plane: deterministic fault injection + recovery policies.
+
+Two halves, one package:
+
+* :mod:`porqua_tpu.resilience.faults` — the induction side. A seeded
+  :class:`FaultInjector` drives a scenario DSL (:class:`Scenario` /
+  :class:`FaultSpec`) through named seams compiled into the existing
+  chokepoints (executable-cache dispatch, batcher/continuous execute,
+  queue admission, device probe, data feed, checkpoint loop). Zero
+  overhead and bit-identical programs when disabled: seams are one
+  module-global predicate, proven program-neutral by the GC104 jaxpr-
+  identity contract, and every seam is guarded by the mechanically
+  enforced ``if faults.enabled():`` pattern (graftcheck GC007).
+* :mod:`porqua_tpu.resilience.retry` — the recovery side.
+  :class:`RetryPolicy` / :class:`RetryManager` wire per-request retry
+  with exponential backoff + seeded jitter, idempotent resubmission
+  keyed by request id (one id, one future, one resolution), deadline-
+  aware give-up, optional hedged duplicates for tail latency, and
+  result validation (the zero-wrong-answers gate) into
+  ``SolveService(retry=RetryPolicy(...))``.
+
+The degradation matrix lives in ``scripts/chaos_suite.py`` (scenario
+grid x {classic, continuous} serve modes, invariant assertions, JSON
+verdict report); ``serve_loadgen.py --chaos NAME`` replays one
+scenario under load. See README "Resilience & chaos testing".
+"""
+
+from porqua_tpu.resilience.faults import (
+    FaultAction,
+    FaultClock,
+    FaultInjector,
+    FaultSpec,
+    InjectedCrash,
+    InjectedFault,
+    Scenario,
+    builtin_scenarios,
+)
+
+_RETRY_NAMES = ("RetryManager", "RetryPolicy", "validate_result")
+
+
+def __getattr__(name):
+    # retry.py imports the serve stack (for the failure taxonomy it
+    # classifies), and the serve stack imports `faults` for its seam
+    # predicates — loading retry lazily keeps this package importable
+    # from inside a serve module's own import (no cycle), same pattern
+    # as porqua_tpu.analysis defers `contracts`.
+    if name in _RETRY_NAMES:
+        import importlib
+
+        mod = importlib.import_module("porqua_tpu.resilience.retry")
+        return getattr(mod, name)
+    raise AttributeError(name)
+
+__all__ = [
+    "FaultAction",
+    "FaultClock",
+    "FaultInjector",
+    "FaultSpec",
+    "InjectedCrash",
+    "InjectedFault",
+    "RetryManager",
+    "RetryPolicy",
+    "Scenario",
+    "builtin_scenarios",
+    "validate_result",
+]

@@ -1,0 +1,472 @@
+"""Deterministic fault injection: seeded seams at the serve chokepoints.
+
+The serve stack carries real recovery machinery — a circuit breaker
+with TPU→XLA-CPU degradation, sanitizer enforcement, continuous-
+batching cohorts, an event bus — but without induced failure none of
+it is *exercised*: the breaker opens only when a probe happens to
+fail. This module is the induction side: a process-global
+:class:`FaultInjector` holding a :class:`Scenario` (a list of
+:class:`FaultSpec` rules), consulted at **named seams** compiled into
+the existing chokepoints:
+
+=====================  ====================================================
+seam                   where it fires
+=====================  ====================================================
+``serve.admission``    ``SolveService.submit`` (queue stall, clock skew)
+``serve.dispatch``     ``MicroBatcher._execute`` before the device call
+``serve.result``       batcher result read-back (NaN/Inf lane corruption)
+``serve.continuous``   ``ContinuousBatcher._tick`` before the step dispatch
+``health.probe``       ``DeviceHealth._probe_with_timeout``
+``cache.get``          ``ExecutableCache._get`` (post-warmup compile storm)
+``data.feed``          ``loadgen`` request stream (corrupt problem data)
+``backtest.chunk``     checkpointed backtest loops, after each chunk save
+=====================  ====================================================
+
+Every seam follows ONE pattern, enforced mechanically by graftcheck
+rule GC007 (:mod:`porqua_tpu.analysis.lint`)::
+
+    from porqua_tpu.resilience import faults as _faults
+    ...
+    if _faults.enabled():
+        act = _faults.fire("serve.dispatch", bucket=label)
+        # interpret ``act`` if the seam handles directives
+
+Disabled (the default, and the only production state) the seam is a
+single module-global ``is not None`` predicate — no injector object,
+no RNG, no allocation — and the traced device programs are untouched:
+seams live strictly in host dispatch code, which the GC104 jaxpr-
+identity contract (:mod:`porqua_tpu.analysis.contracts`) proves by
+tracing the solve/serve entry points with and without an installed
+injector and requiring string-identical jaxprs.
+
+Determinism: each ``(seam, kind)`` rule carries its own counter and
+its own ``numpy`` Generator seeded from ``(scenario.seed, seam,
+kind)``, so a seam's fault sequence depends only on how many times
+*that seam* was hit — not on thread interleavings across seams — and
+replaying a scenario replays its faults exactly.
+
+Fault kinds (the scenario DSL):
+
+``device_lost``     raise :class:`InjectedFault` at a dispatch seam —
+                    the batcher's device-fault path counts it toward
+                    the circuit breaker, exactly like a real XLA error.
+``probe_fail``      directive ``fail`` at ``health.probe`` — the probe
+                    reports unhealthy without touching a device
+                    (models both fast device loss and the black-hole
+                    timeout; an optional ``stall_s`` sleeps first).
+``nan_lanes``       directive at ``serve.result`` — corrupt ``lanes``
+                    result rows to NaN/Inf *on the host copy* (the
+                    device program never sees it); the retry layer's
+                    validation must catch it, or the caller would
+                    receive a wrong answer.
+``compile_storm``   directive at ``cache.get`` — evict the cache entry
+                    so a post-warmup dispatch pays a fresh AOT compile.
+``queue_stall``     directive ``stall_s`` at ``serve.admission`` —
+                    admission sleeps, aging every queued deadline.
+``clock_skew``      directive ``skew_s`` at ``serve.admission`` — the
+                    request's deadline budget is shortened as if the
+                    submitter's clock ran ahead of the service's.
+``feed_corrupt``    directive at ``data.feed`` — poison the request's
+                    objective vector with NaN before submission.
+``crash``           raise :class:`InjectedCrash` at ``backtest.chunk``
+                    — kill a checkpointed backtest mid-run to drive
+                    the crash-resume parity tests.
+
+Host-only module by design: importing it must never initialize a JAX
+backend (it is imported by every serve module for the seam predicate).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "FaultAction",
+    "FaultClock",
+    "FaultInjector",
+    "FaultSpec",
+    "InjectedCrash",
+    "InjectedFault",
+    "KINDS",
+    "SEAMS",
+    "Scenario",
+    "active",
+    "builtin_scenarios",
+    "corrupt_feed",
+    "enabled",
+    "fire",
+    "install",
+    "uninstall",
+]
+
+#: Every seam name compiled into the stack (unknown names raise at
+#: Scenario construction — a typo'd seam must not silently never fire).
+SEAMS = (
+    "serve.admission",
+    "serve.dispatch",
+    "serve.result",
+    "serve.continuous",
+    "health.probe",
+    "cache.get",
+    "data.feed",
+    "backtest.chunk",
+)
+
+#: kind -> seams it is allowed to target (the DSL's type system).
+KINDS: Dict[str, Tuple[str, ...]] = {
+    "device_lost": ("serve.dispatch", "serve.continuous"),
+    "probe_fail": ("health.probe",),
+    "nan_lanes": ("serve.result",),
+    "compile_storm": ("cache.get",),
+    "queue_stall": ("serve.admission",),
+    "clock_skew": ("serve.admission",),
+    "feed_corrupt": ("data.feed",),
+    "crash": ("backtest.chunk",),
+}
+
+
+class InjectedFault(RuntimeError):
+    """A deliberately induced device/dispatch fault. Deliberately a
+    plain RuntimeError subclass: the serve stack must treat it through
+    the SAME containment paths as a real XLA error (breaker counting,
+    fallback retry) — special-casing it would test nothing."""
+
+
+class InjectedCrash(BaseException):
+    """A deliberately induced process death for crash-resume tests.
+
+    Derives from BaseException so ordinary ``except Exception``
+    containment (the batcher's, the checkpoint loop's) cannot swallow
+    it — a real ``kill -9`` wouldn't be swallowed either.
+    """
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One injection rule: *at this seam, starting at hit index
+    ``start``, fire ``count`` times with probability ``p`` per
+    eligible hit*. ``args`` parameterizes the kind (``lanes``,
+    ``stall_s``, ``skew_s``, ...)."""
+
+    seam: str
+    kind: str
+    start: int = 0               # first eligible hit index (0-based)
+    count: int = 1               # max fires (None/inf not allowed: bounded)
+    p: float = 1.0               # per-hit probability, seeded RNG
+    args: Tuple[Tuple[str, Any], ...] = ()
+
+    def __post_init__(self):
+        if self.seam not in SEAMS:
+            raise ValueError(
+                f"unknown seam {self.seam!r}; known: {', '.join(SEAMS)}")
+        allowed = KINDS.get(self.kind)
+        if allowed is None:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r}; known: "
+                f"{', '.join(KINDS)}")
+        if self.seam not in allowed:
+            raise ValueError(
+                f"fault kind {self.kind!r} cannot target seam "
+                f"{self.seam!r} (allowed: {', '.join(allowed)})")
+        if self.count < 1:
+            raise ValueError("count must be >= 1")
+        if not 0.0 < self.p <= 1.0:
+            raise ValueError("p must be in (0, 1]")
+
+    @staticmethod
+    def make(seam: str, kind: str, start: int = 0, count: int = 1,
+             p: float = 1.0, **args) -> "FaultSpec":
+        """Keyword-args convenience constructor (``args`` as kwargs)."""
+        return FaultSpec(seam=seam, kind=kind, start=int(start),
+                         count=int(count), p=float(p),
+                         args=tuple(sorted(args.items())))
+
+
+@dataclasses.dataclass(frozen=True)
+class Scenario:
+    """A named, seeded fault program: the unit the chaos suite runs."""
+
+    name: str
+    faults: Tuple[FaultSpec, ...]
+    seed: int = 0
+
+    def __post_init__(self):
+        object.__setattr__(self, "faults", tuple(self.faults))
+
+
+class FaultAction:
+    """What :func:`fire` hands back to a directive-interpreting seam."""
+
+    __slots__ = ("kind", "args", "rng")
+
+    def __init__(self, kind: str, args: Dict[str, Any],
+                 rng: np.random.Generator) -> None:
+        self.kind = kind
+        self.args = args
+        self.rng = rng  # the spec's own stream, for e.g. lane choice
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"FaultAction({self.kind!r}, {self.args!r})"
+
+
+class _SpecState:
+    """Per-spec mutable state: hit counter, fire counter, RNG."""
+
+    __slots__ = ("spec", "hits", "fires", "rng")
+
+    def __init__(self, spec: FaultSpec, seed: int) -> None:
+        self.spec = spec
+        self.hits = 0
+        self.fires = 0
+        # Seeded from (scenario seed, seam, kind, start): the stream is
+        # a function of the rule's identity alone, so concurrent seams
+        # cannot perturb each other's draw sequences.
+        self.rng = np.random.default_rng(
+            np.random.SeedSequence(
+                [seed, _stable_hash(spec.seam), _stable_hash(spec.kind),
+                 spec.start]))
+
+
+def _stable_hash(s: str) -> int:
+    """Deterministic across processes (builtin hash() is salted)."""
+    h = 2166136261
+    for ch in s.encode():
+        h = ((h ^ ch) * 16777619) & 0xFFFFFFFF
+    return h
+
+
+class FaultInjector:
+    """One scenario's live state. Thread-safe; optional metrics/events
+    hooks so every injected fault is a counter bump
+    (``faults_injected``) and a ``fault_injected`` event next to the
+    recovery it is supposed to trigger."""
+
+    def __init__(self, scenario: Scenario, metrics=None,
+                 events=None) -> None:
+        self.scenario = scenario
+        self.metrics = metrics
+        self.events = events
+        self._lock = threading.Lock()
+        self._states: Dict[str, List[_SpecState]] = {}  # guarded-by: self._lock
+        for spec in scenario.faults:
+            self._states.setdefault(spec.seam, []).append(
+                _SpecState(spec, scenario.seed))
+        self._log: List[Dict[str, Any]] = []            # guarded-by: self._lock
+
+    # -- seam side ----------------------------------------------------
+
+    def fire(self, seam: str, **ctx) -> Optional[FaultAction]:
+        """Consult the scenario at one seam hit. Raising kinds
+        (``device_lost``, ``crash``) raise; directive kinds return a
+        :class:`FaultAction` the seam interprets; a quiet hit returns
+        None. At most one rule fires per hit (specs are consulted in
+        scenario order)."""
+        with self._lock:
+            states = self._states.get(seam)
+            if not states:
+                return None
+            fired: Optional[_SpecState] = None
+            for st in states:
+                idx = st.hits
+                st.hits += 1
+                spec = st.spec
+                if fired is not None or idx < spec.start \
+                        or st.fires >= spec.count:
+                    continue
+                if spec.p < 1.0 and st.rng.random() >= spec.p:
+                    continue
+                st.fires += 1
+                fired = st
+            if fired is None:
+                return None
+            spec = fired.spec
+            self._log.append({"seam": seam, "kind": spec.kind,
+                              "hit": fired.hits - 1, **ctx})
+        # Hooks run outside the injector lock: emit/inc take their own.
+        if self.metrics is not None:
+            self.metrics.inc("faults_injected")
+        if self.events is not None:
+            reserved = ("kind", "fault_kind", "severity", "trace_id",
+                        "seam", "scenario", "t")
+            self.events.emit("fault_injected", "warn", seam=seam,
+                             fault_kind=spec.kind,
+                             scenario=self.scenario.name,
+                             **{k: v for k, v in ctx.items()
+                                if k not in reserved
+                                and isinstance(v, (str, int, float, bool))})
+        if spec.kind == "device_lost":
+            raise InjectedFault(
+                f"injected device loss at {seam} "
+                f"(scenario {self.scenario.name!r})")
+        if spec.kind == "crash":
+            raise InjectedCrash(
+                f"injected crash at {seam} "
+                f"(scenario {self.scenario.name!r})")
+        return FaultAction(spec.kind, dict(spec.args), fired.rng)
+
+    # -- readers ------------------------------------------------------
+
+    def log(self) -> List[Dict[str, Any]]:
+        """Every fault fired so far (deterministic replay record)."""
+        with self._lock:
+            return list(self._log)
+
+    def fires(self, seam: Optional[str] = None) -> int:
+        with self._lock:
+            return sum(st.fires for s, sts in self._states.items()
+                       if seam is None or s == seam for st in sts)
+
+    def exhausted(self) -> bool:
+        """Every rule has fired its full count (the scenario's induced-
+        failure window is over; recovery invariants may be asserted)."""
+        with self._lock:
+            return all(st.fires >= st.spec.count
+                       for sts in self._states.values() for st in sts)
+
+
+# ---------------------------------------------------------------------------
+# process-global install point (the seams' single predicate)
+# ---------------------------------------------------------------------------
+
+_INJECTOR: Optional[FaultInjector] = None
+_install_lock = threading.Lock()
+
+
+def enabled() -> bool:
+    """The seam predicate: True iff an injector is installed. One
+    module-global read — the entire disabled-path cost."""
+    return _INJECTOR is not None
+
+
+def install(injector: FaultInjector) -> FaultInjector:
+    """Install the process-global injector (exclusive: installing over
+    a live one raises — two scenarios sharing seams would destroy both
+    scenarios' determinism)."""
+    global _INJECTOR
+    with _install_lock:
+        if _INJECTOR is not None:
+            raise RuntimeError(
+                f"a fault injector is already installed (scenario "
+                f"{_INJECTOR.scenario.name!r}); uninstall() it first")
+        _INJECTOR = injector
+    return injector
+
+
+def uninstall() -> None:
+    global _INJECTOR
+    with _install_lock:
+        _INJECTOR = None
+
+
+def fire(seam: str, **ctx) -> Optional[FaultAction]:
+    """Module-level seam entry: delegates to the installed injector.
+    Callers MUST guard with ``if faults.enabled():`` (GC007) — the
+    injector reference is re-read here, so a concurrent uninstall
+    degrades to a no-op rather than an AttributeError."""
+    inj = _INJECTOR
+    if inj is None:
+        return None
+    return inj.fire(seam, **ctx)
+
+
+def corrupt_feed(qp, action: FaultAction):
+    """Apply a ``feed_corrupt`` directive to one request: NaN the
+    first ``lanes`` entries (default 1) of the objective vector and
+    return the poisoned problem. ONE definition shared by every
+    ``data.feed`` driver (``serve.loadgen`` and the chaos suite), so
+    the suite exercises exactly the corruption the load generator
+    injects — partial-lane poison included."""
+    bad_q = np.array(qp.q, copy=True)
+    bad_q[: max(int(action.args.get("lanes", 1)), 1)] = np.nan
+    return qp._replace(q=bad_q)
+
+
+@contextlib.contextmanager
+def active(scenario: Scenario, metrics=None, events=None):
+    """``with faults.active(scenario) as inj:`` — install for the
+    block, uninstall on exit (exception-safe; the chaos suite's and
+    the tests' entry point)."""
+    inj = install(FaultInjector(scenario, metrics=metrics, events=events))
+    try:
+        yield inj
+    finally:
+        uninstall()
+
+
+# ---------------------------------------------------------------------------
+# injectable clock
+# ---------------------------------------------------------------------------
+
+class FaultClock:
+    """A steppable monotonic clock for deterministic replay of time-
+    dependent recovery paths (breaker re-close, deadline give-up).
+    Thread-safe; call it like ``time.monotonic`` (``DeviceHealth`` and
+    ``RetryManager`` accept any zero-arg float callable)."""
+
+    def __init__(self, start: float = 0.0) -> None:
+        self._lock = threading.Lock()
+        self._now = float(start)   # guarded-by: self._lock
+
+    def __call__(self) -> float:
+        with self._lock:
+            return self._now
+
+    def advance(self, seconds: float) -> float:
+        """Step time forward; returns the new now."""
+        with self._lock:
+            self._now += float(seconds)
+            return self._now
+
+
+# ---------------------------------------------------------------------------
+# builtin scenario catalog (the chaos suite's degradation matrix)
+# ---------------------------------------------------------------------------
+
+def builtin_scenarios(seed: int = 0) -> Dict[str, Scenario]:
+    """The named scenario grid ``scripts/chaos_suite.py`` runs and
+    ``loadgen --chaos NAME`` replays. Counts are small and bounded on
+    purpose: every scenario has a failure window that CLOSES, so the
+    recovery invariant (breaker re-closes, retries drain, service
+    returns to steady state) is assertable after it."""
+    mk = FaultSpec.make
+    return {
+        # Two consecutive dispatch losses: exactly the breaker's
+        # failure_threshold default, so the scenario proves open →
+        # fallback-retry → (probe ok) → re-close.
+        "device_lost": Scenario("device_lost", (
+            mk("serve.dispatch", "device_lost", count=2),
+            mk("serve.continuous", "device_lost", count=2),
+        ), seed=seed),
+        # The VERDICT.md black-hole: probes fail (as timeouts do) until
+        # the window closes, then the primary answers again.
+        "probe_blackhole": Scenario("probe_blackhole", (
+            mk("health.probe", "probe_fail", count=3),
+        ), seed=seed),
+        # Corrupt result lanes: the zero-wrong-answers invariant's
+        # sharpest test — validation must catch every one.
+        "nan_lanes": Scenario("nan_lanes", (
+            mk("serve.result", "nan_lanes", count=3, lanes=2),
+        ), seed=seed),
+        # Post-warmup compile storm: evict executables mid-traffic.
+        "compile_storm": Scenario("compile_storm", (
+            mk("cache.get", "compile_storm", start=1, count=3),
+        ), seed=seed),
+        # Admission stalls age the queue into deadline territory.
+        "queue_stall": Scenario("queue_stall", (
+            mk("serve.admission", "queue_stall", count=4, stall_s=0.05),
+        ), seed=seed),
+        # Submitter clock running ahead: deadlines arrive pre-aged.
+        "clock_skew": Scenario("clock_skew", (
+            mk("serve.admission", "clock_skew", count=4, p=0.5,
+               skew_s=30.0),
+        ), seed=seed),
+        # Poisoned feed data: the request must FAIL, never mis-answer.
+        "feed_corrupt": Scenario("feed_corrupt", (
+            mk("data.feed", "feed_corrupt", count=2),
+        ), seed=seed),
+    }

@@ -36,6 +36,7 @@ import numpy as np
 from porqua_tpu.analysis import sanitize
 from porqua_tpu.qp.admm import Status
 from porqua_tpu.qp.canonical import CanonicalQP, stack_qps
+from porqua_tpu.resilience import faults as _faults
 from porqua_tpu.serve.bucketing import Bucket, ExecutableCache, slot_count
 
 
@@ -54,6 +55,26 @@ def problem_fingerprint(qp: CanonicalQP) -> str:
         h.update(str(arr.shape).encode())
         h.update(arr.tobytes())
     return h.hexdigest()
+
+
+def _corrupt_lanes(xs: np.ndarray, n_live: int, seam: str,
+                   bucket_label: str) -> np.ndarray:
+    """serve.result seam body: a ``nan_lanes`` directive poisons up to
+    ``lanes`` live result rows with NaN on the HOST copy — the device
+    program is untouched, and the corruption must be caught by the
+    retry layer's result validation or the caller would receive a
+    wrong answer (the chaos suite's zero-wrong-answers invariant tests
+    exactly this edge)."""
+    act = None
+    if _faults.enabled():
+        act = _faults.fire(seam, live=n_live, bucket=bucket_label)
+    if act is None or act.kind != "nan_lanes" or n_live == 0:
+        return xs
+    k = min(int(act.args.get("lanes", 1)), n_live)
+    rows = act.rng.choice(n_live, size=k, replace=False)
+    xs = np.array(xs, copy=True)  # device read-back views are read-only
+    xs[rows] = np.nan
+    return xs
 
 
 class DeadlineExpired(Exception):
@@ -319,6 +340,9 @@ class MicroBatcher:
         t_exec1 = time.monotonic()
 
         xs = np.asarray(sol.x)
+        if _faults.enabled():
+            xs = _corrupt_lanes(xs, len(live), "serve.result",
+                                f"{bucket.n}x{bucket.m}")
         ys = np.asarray(sol.y)
         status = np.asarray(sol.status)
         iters = np.asarray(sol.iters)
@@ -367,7 +391,12 @@ class MicroBatcher:
         record their spans BEFORE calling."""
         m = self.metrics
         ok = int(status[i]) == Status.SOLVED
-        if ok and r.warm_key is not None and self.warm_cache is not None:
+        if (ok and r.warm_key is not None and self.warm_cache is not None
+                and np.all(np.isfinite(xs[i])) and np.all(np.isfinite(ys[i]))):
+            # A non-finite row (injected nan_lanes corruption, or any
+            # real corrupted read-back) must not outlive its request: a
+            # poisoned warm start would seed NaN into every later solve
+            # under this key, long after the fault window closed.
             self.warm_cache.put((r.warm_key, bucket), xs[i], ys[i])
         m.observe_latency(done - r.submitted)
         m.inc("completed")
@@ -404,6 +433,16 @@ class MicroBatcher:
         for _attempt in range(4):  # bounded: threshold trips inside this
             device = self.health.device()
             try:
+                if _faults.enabled():
+                    # serve.dispatch seam: an injected device loss
+                    # raises here, INSIDE the containment loop, so it
+                    # rides the exact breaker/fallback path a real XLA
+                    # fault takes — nothing below special-cases it.
+                    _faults.fire(
+                        "serve.dispatch",
+                        bucket=f"{bucket.n}x{bucket.m}",
+                        device=(f"{device.platform}:{device.id}"
+                                if device is not None else "default"))
                 exe = self.cache.get(bucket, slots, dtype, device)
                 t0 = time.perf_counter()
                 sol = self._call_executable(exe, device, qp, x0, y0)

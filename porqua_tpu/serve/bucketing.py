@@ -30,6 +30,7 @@ import numpy as np
 
 from porqua_tpu.analysis import sanitize
 from porqua_tpu.qp.canonical import CanonicalQP, pad_qp
+from porqua_tpu.resilience import faults as _faults
 from porqua_tpu.qp.solve import (
     SolverParams,
     aot_compile_batch,
@@ -182,6 +183,16 @@ class ExecutableCache:
         key = (kind, bucket, int(slots), np.dtype(dtype).str,
                self._device_key(device))
         with self._lock:
+            if _faults.enabled():
+                # cache.get seam: a compile_storm directive evicts this
+                # entry, so a post-warmup dispatch pays a fresh AOT
+                # compile — the induced form of the steady-state-
+                # recompile regression the compile counters/events (and
+                # PORQUA_SANITIZE) exist to surface.
+                act = _faults.fire("cache.get", cache_kind=kind,
+                                   slots=int(slots))
+                if act is not None and act.kind == "compile_storm":
+                    self._cache.pop(key, None)
             exe = self._cache.get(key)
             if exe is not None:
                 if self.metrics is not None:

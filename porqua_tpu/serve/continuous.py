@@ -57,11 +57,13 @@ import numpy as np
 from porqua_tpu.analysis import sanitize
 from porqua_tpu.qp.admm import Status
 from porqua_tpu.qp.canonical import CanonicalQP, stack_qps
+from porqua_tpu.resilience import faults as _faults
 from porqua_tpu.serve.batcher import (
     DeadlineExpired,
     MicroBatcher,
     SolveError,
     SolveRequest,
+    _corrupt_lanes,
 )
 from porqua_tpu.serve.bucketing import Bucket, slot_count
 
@@ -401,6 +403,16 @@ class ContinuousBatcher(MicroBatcher):
         m.observe_queue_depth(self.queue.qsize() + sum(
             len(d) for d in self._pending.values()))
         t0 = time.monotonic()
+        if _faults.enabled():
+            # serve.continuous seam: an injected device loss raises
+            # into _tick_safe's containment — the cohort fails loudly
+            # (no state migration), the breaker counts the fault, and
+            # the next cohort forms on whatever device the health
+            # manager then trusts; retry-policied requests resubmit
+            # into it.
+            _faults.fire("serve.continuous",
+                         bucket=f"{bucket.n}x{bucket.m}",
+                         slots=cohort.slots)
         active_dev = cohort.active.copy()
         carry, status, _iters = self._call(
             cohort.step_exe, cohort.device, cohort.scaled,
@@ -452,6 +464,9 @@ class ContinuousBatcher(MicroBatcher):
                     else np.asarray(jax.device_get(a[ridx])))
 
         xs, ys = take(sol.x), take(sol.y)
+        if _faults.enabled():
+            xs = _corrupt_lanes(xs, len(retire), "serve.result",
+                                f"{bucket.n}x{bucket.m}")
         fstat, fit = take(sol.status), take(sol.iters)
         prim, dual, obj = (take(sol.prim_res), take(sol.dual_res),
                            take(sol.obj_val))

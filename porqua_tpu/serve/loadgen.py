@@ -30,6 +30,7 @@ import numpy as np
 from porqua_tpu.qp.admm import Status
 from porqua_tpu.qp.canonical import CanonicalQP
 from porqua_tpu.qp.solve import SolverParams
+from porqua_tpu.resilience import faults as _faults
 from porqua_tpu.serve.service import QueueFull, SolveService
 from porqua_tpu.tracking import synthetic_universe_np
 
@@ -98,7 +99,11 @@ def run_loadgen(requests: List[CanonicalQP],
                 ring_size: int = 0,
                 ring_samples: int = 8,
                 continuous: bool = False,
-                segment_budget: Optional[int] = None) -> Dict:
+                segment_budget: Optional[int] = None,
+                retry=None,
+                chaos=None,
+                chaos_seed: int = 0,
+                no_retry: bool = False) -> Dict:
     """Drive ``requests`` through a :class:`SolveService`; return the
     report dict (throughput, percentiles, occupancy, recompiles).
 
@@ -121,11 +126,57 @@ def run_loadgen(requests: List[CanonicalQP],
     ``scripts/obs_report.py`` renders as sparklines. Both artifacts
     require the service to be created here (an external ``service``
     carries its own ``obs``).
+
+    Resilience: ``retry`` (a :class:`porqua_tpu.resilience.RetryPolicy`)
+    routes every request through the service's recovery layer — the
+    report's ``retries`` / ``hedges_fired`` / ``hedges_won`` /
+    ``resumed_requests`` fields move. ``chaos`` names a builtin fault
+    scenario (:func:`porqua_tpu.resilience.builtin_scenarios`, or pass
+    a ``Scenario`` directly) installed for the MEASURED phase only —
+    prewarm and the warmup round run clean, then the injector perturbs
+    live traffic exactly as ``scripts/chaos_suite.py`` does under its
+    invariant checks. With ``chaos`` set and no explicit ``retry``, the
+    default :class:`RetryPolicy` is applied (an injected fault without
+    the recovery layer just errors the request — measuring that is
+    opting out, not a default). Both knobs apply at service
+    construction, so an externally-built ``service`` must already
+    carry its retry policy — passing ``retry`` (or ``chaos``, which
+    implies one) alongside a retry-less external service raises
+    instead of silently running without the validation gate.
+    ``no_retry=True`` is the documented opt-out: it suppresses the
+    chaos-implied default policy so raw (unrecovered) fault behavior
+    can be measured. Caveat: only requests that FAIL (device faults,
+    ``feed_corrupt`` rejections, expiries) surface as ``errors``;
+    without the retry layer there is no validation gate, so a
+    ``nan_lanes``-corrupted result still resolves with its on-device
+    status (typically SOLVED) and is counted as completed — the
+    wrong-answer exposure the validation gate exists to close.
     """
     if mode not in ("closed", "open"):
         raise ValueError(f"unknown mode {mode!r}; expected closed|open")
     if mode == "open" and not rate:
         raise ValueError("open-loop mode requires a rate (solves/s)")
+    if no_retry and retry is not None:
+        raise ValueError("no_retry=True contradicts an explicit retry "
+                         "policy; pass one or the other")
+    scenario = None
+    retry_requested = retry is not None
+    if chaos is not None:
+        from porqua_tpu.resilience.faults import Scenario, builtin_scenarios
+
+        if isinstance(chaos, Scenario):
+            scenario = chaos
+        else:
+            catalog = builtin_scenarios(seed=chaos_seed)
+            if chaos not in catalog:
+                raise ValueError(
+                    f"unknown chaos scenario {chaos!r}; builtin: "
+                    f"{', '.join(sorted(catalog))}")
+            scenario = catalog[chaos]
+        if retry is None and not no_retry:
+            from porqua_tpu.resilience.retry import RetryPolicy
+
+            retry = RetryPolicy()
 
     obs = None
     own_service = service is None
@@ -140,10 +191,41 @@ def run_loadgen(requests: List[CanonicalQP],
                                max_wait_ms=max_wait_ms,
                                queue_capacity=max(4 * max_batch, 1024),
                                obs=obs, continuous=continuous,
-                               segment_budget=segment_budget)
+                               segment_budget=segment_budget,
+                               retry=retry)
         service.start()
     else:
         obs = service.obs
+        if service._retry is None:
+            # A retry policy is applied at service construction — it
+            # cannot be retrofitted here, and silently dropping it
+            # would run chaos without the validation gate (corrupting
+            # scenarios could then hand callers wrong answers).
+            if retry_requested:
+                raise ValueError(
+                    "run_loadgen cannot apply a retry policy to an "
+                    "externally-built service; construct it with "
+                    "SolveService(retry=...)")
+            if scenario is not None and not no_retry:
+                raise ValueError(
+                    "chaos against an externally-built service "
+                    "requires it to carry a retry policy "
+                    "(SolveService(retry=RetryPolicy(...))): the "
+                    "validation gate is what keeps corrupting "
+                    "scenarios from resolving wrong answers "
+                    "(pass no_retry=True to measure raw fault "
+                    "behavior without it)")
+        elif no_retry:
+            # The opt-out cannot be honored either — the external
+            # service's retry layer intercepts every submit. Silently
+            # running WITH recovery would report retried/validated
+            # behavior the caller explicitly asked to exclude.
+            raise ValueError(
+                "no_retry=True cannot be honored for an externally-"
+                "built service that carries a retry policy; construct "
+                "it without SolveService(retry=...) to measure raw "
+                "fault behavior")
+    injector = None
     try:
         # Prewarm every slot-ladder executable for the stream's bucket,
         # then reset the window: measured `compiles` == recompiles.
@@ -154,6 +236,15 @@ def run_loadgen(requests: List[CanonicalQP],
         for t in warm_tickets:
             service.result(t, timeout=120)
         service.metrics.reset_window()
+
+        if scenario is not None:
+            # The chaos window opens AFTER prewarm + warmup: faults
+            # perturb steady-state traffic (the thing production would
+            # feel), not the compile phase the protocol already
+            # excludes from measurement.
+            injector = _faults.install(_faults.FaultInjector(
+                scenario, metrics=service.metrics,
+                events=None if obs is None else obs.events))
 
         errors: List[str] = []
         tickets = []
@@ -171,6 +262,15 @@ def run_loadgen(requests: List[CanonicalQP],
                 delay = next_due - time.perf_counter()
                 if delay > 0:
                     time.sleep(delay)
+            if _faults.enabled():
+                # data.feed seam: a feed_corrupt directive poisons THIS
+                # request's objective vector before submission — the
+                # request must FAIL (validation withholds the garbage
+                # answer, retries of the same poisoned data give up),
+                # never resolve with a wrong answer.
+                act = _faults.fire("data.feed", i=i)
+                if act is not None and act.kind == "feed_corrupt":
+                    qp = _faults.corrupt_feed(qp, act)
             try:
                 # Open-loop arrivals must never block on a full queue —
                 # blocking would silently degrade the fixed-rate
@@ -206,6 +306,12 @@ def run_loadgen(requests: List[CanonicalQP],
             except Exception as exc:  # noqa: BLE001 - reported, not fatal
                 errors.append(f"{type(exc).__name__}: {exc}")
         elapsed = time.perf_counter() - t0
+        if injector is not None:
+            # Close the chaos window before reading the final state:
+            # the report describes a service that has been through its
+            # scenario, not one still being perturbed.
+            _faults.uninstall()
+            injector = None
         # Throughput counts requests that actually resolved with a
         # solution (one definition, shared with the snapshot's
         # completed/window) — failed/expired/dropped requests are cheap
@@ -272,6 +378,17 @@ def run_loadgen(requests: List[CanonicalQP],
             "errors": len(errors),
             "dropped_arrivals": dropped,
             "error_sample": errors[:3],
+            # Resilience plane: recovery-layer activity during the
+            # measured window (all 0 without a retry policy) and, under
+            # --chaos, how hard the scenario actually hit.
+            "retries": snap["retries"],
+            "hedges_fired": snap["hedges_fired"],
+            "hedges_won": snap["hedges_won"],
+            "resumed_requests": snap["resumed_requests"],
+            "retry_giveups": snap["retry_giveups"],
+            "validation_failures": snap["validation_failures"],
+            "chaos": None if scenario is None else scenario.name,
+            "faults_injected": snap["faults_injected"],
             "latency_p50_ms": snap["latency_p50_ms"],
             "latency_p99_ms": snap["latency_p99_ms"],
             "latency_mean_ms": snap["latency_mean_ms"],
@@ -286,5 +403,9 @@ def run_loadgen(requests: List[CanonicalQP],
             "iters_mean": snap["iters_mean"],
         }
     finally:
+        if injector is not None:
+            # Exception path: the injector must not outlive this run
+            # (a process-global injector would perturb the next one).
+            _faults.uninstall()
         if own_service:
             service.stop()

@@ -56,6 +56,14 @@ COUNTERS = (
     "status_max_iter",
     "status_primal_infeasible",
     "status_dual_infeasible",
+    # Resilience plane (porqua_tpu.resilience):
+    "retries",              # retry attempts scheduled after a failure
+    "hedges_fired",         # duplicate (hedged) submissions issued
+    "hedges_won",           # requests resolved by their hedge
+    "resumed_requests",     # requests completed only via retry/hedge
+    "retry_giveups",        # requests abandoned (attempts or deadline)
+    "validation_failures",  # results withheld as non-finite
+    "faults_injected",      # chaos: faults the injector fired
 )
 
 #: Status code -> counter suffix (mirrors porqua_tpu.qp.admm.Status —

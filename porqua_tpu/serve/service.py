@@ -31,6 +31,7 @@ import numpy as np
 
 from porqua_tpu.qp.canonical import CanonicalQP
 from porqua_tpu.qp.solve import SolverParams
+from porqua_tpu.resilience import faults as _faults
 from porqua_tpu.serve.batcher import (
     DeadlineExpired,
     MicroBatcher,
@@ -74,7 +75,8 @@ class DeviceHealth:
                  probe_timeout_s: float = 30.0,
                  recovery_interval_s: float = 60.0,
                  metrics: Optional[ServeMetrics] = None,
-                 events=None) -> None:
+                 events=None,
+                 clock=None) -> None:
         self.primary = jax.devices()[0] if primary is None else primary
         if fallback is None:
             try:
@@ -90,6 +92,12 @@ class DeviceHealth:
         # Optional porqua_tpu.obs.EventBus: circuit-breaker transitions
         # and probe failures become structured events.
         self.events = events
+        # Injectable monotonic clock: every breaker timing decision
+        # (open timestamp, re-close eligibility) reads it, so chaos
+        # scenarios replay the recovery path deterministically against
+        # a stepped porqua_tpu.resilience.FaultClock instead of
+        # waiting out wall-clock recovery intervals.
+        self.clock = time.monotonic if clock is None else clock
         self._lock = threading.Lock()
         self._failures = 0            # guarded-by: self._lock
         self._degraded = False        # guarded-by: self._lock
@@ -109,18 +117,37 @@ class DeviceHealth:
         """A black-holed device HANGS probes rather than failing them;
         run the probe on a scrap daemon thread and treat a timeout as a
         failure (the thread is abandoned — it holds no locks)."""
-        result = []
+        injected = None
+        if _faults.enabled():
+            # health.probe seam: a probe_fail directive reports the
+            # device unhealthy without dispatching to it — the induced
+            # form of both the fast device loss and (with stall_s) the
+            # black-hole timeout the breaker exists for.
+            injected = _faults.fire(
+                "health.probe",
+                device=f"{device.platform}:{device.id}")
+        if injected is not None and injected.kind == "probe_fail":
+            # The stall models the black-hole HANG, so it is bounded by
+            # the same probe_timeout_s that caps the real path below —
+            # a longer injected sleep would delay breaker trip/recovery
+            # beyond anything the modeled timeout permits.
+            stall = float(injected.args.get("stall_s", 0.0))
+            if stall:
+                time.sleep(min(stall, self.probe_timeout_s))
+            ok = False
+        else:
+            result = []
 
-        def run():
-            try:
-                result.append(bool(self.probe_fn(device)))
-            except Exception:  # noqa: BLE001 - any fault = unhealthy
-                result.append(False)
+            def run():
+                try:
+                    result.append(bool(self.probe_fn(device)))
+                except Exception:  # noqa: BLE001 - any fault = unhealthy
+                    result.append(False)
 
-        t = threading.Thread(target=run, daemon=True)
-        t.start()
-        t.join(self.probe_timeout_s)
-        ok = bool(result and result[0])
+            t = threading.Thread(target=run, daemon=True)
+            t.start()
+            t.join(self.probe_timeout_s)
+            ok = bool(result and result[0])
         if not ok:
             if self.metrics is not None:
                 self.metrics.inc("probe_failures")
@@ -133,7 +160,7 @@ class DeviceHealth:
 
     def _trip(self) -> None:  # guarded-by: self._lock
         self._degraded = True
-        self._opened_at = time.monotonic()
+        self._opened_at = self.clock()
         if self.metrics is not None:
             self.metrics.inc("device_switches")
         if self.events is not None:
@@ -174,7 +201,7 @@ class DeviceHealth:
                 return self.primary
             if (self.primary is not self.fallback
                     and not self._recovery_inflight
-                    and time.monotonic() - self._opened_at
+                    and self.clock() - self._opened_at
                     >= self.recovery_interval_s):
                 self._recovery_inflight = True
                 threading.Thread(target=self._try_recover,
@@ -200,7 +227,7 @@ class DeviceHealth:
                                 f"{self.primary.id}")
                 self._publish()
             else:
-                self._opened_at = time.monotonic()
+                self._opened_at = self.clock()
 
     def record_success(self) -> None:
         with self._lock:
@@ -246,6 +273,8 @@ class SolveService:
                  obs=None,
                  continuous: bool = False,
                  segment_budget: Optional[int] = None,
+                 retry=None,
+                 cache: Optional[ExecutableCache] = None,
                  **health_kwargs) -> None:
         self.params = params
         self.continuous = bool(continuous)
@@ -265,8 +294,33 @@ class SolveService:
             # An externally-built health manager still reports through
             # this service's bus unless it already has its own.
             self.health.events = events
-        self.cache = ExecutableCache(params, metrics=self.metrics,
-                                     events=events)
+        if cache is None:
+            cache = ExecutableCache(params, metrics=self.metrics,
+                                    events=events)
+        elif cache.params != params:
+            # A shared cache (e.g. the chaos suite reusing compiled
+            # executables across scenario services) must solve at THIS
+            # service's configuration, not silently at its creator's.
+            raise ValueError(
+                "shared ExecutableCache was built for different "
+                "SolverParams than this service's")
+        self.cache = cache
+        # Optional request-level recovery layer
+        # (porqua_tpu.resilience.retry): retry with backoff + jitter,
+        # idempotent resubmission by request id, deadline-aware
+        # give-up, hedged duplicates, result validation. None = the
+        # raw submit path, byte-for-byte the pre-resilience behavior.
+        self._retry = None
+        if retry is not None:
+            from porqua_tpu.resilience.retry import RetryManager
+
+            # NOTE: the retry scheduler keeps ITS default (real)
+            # clock even when the health manager runs on an injected
+            # one — freezing backoff/hedge timers is never what a
+            # breaker-clock chaos scenario means; pass an explicit
+            # RetryManager for full fake-time control.
+            self._retry = RetryManager(self, retry, self.metrics,
+                                       events=events)
         batcher_kwargs = dict(
             max_batch=max_batch, max_wait_ms=max_wait_ms,
             queue_capacity=queue_capacity,
@@ -295,6 +349,8 @@ class SolveService:
     def start(self) -> "SolveService":
         self.health.startup_check()
         self.batcher.start()
+        if self._retry is not None:
+            self._retry.start()
         self._started = True
         return self
 
@@ -303,8 +359,16 @@ class SolveService:
             self._http.stop()
             self._http = None
         if self._started:
-            self.batcher.stop(timeout=timeout)
+            # Refuse new submits first, flush the batcher second, and
+            # stop the retry layer LAST: the flush can still fail
+            # in-flight attempts, and those failures must land in a
+            # retry layer that is alive enough to record them —
+            # RetryManager.stop() then fails every still-unresolved
+            # future so no caller blocks forever on an abandoned retry.
             self._started = False
+            self.batcher.stop(timeout=timeout)
+            if self._retry is not None:
+                self._retry.stop()
 
     def start_http(self, port: int = 0, host: str = "127.0.0.1") -> int:
         """Expose ``/metrics`` (Prometheus text) and ``/healthz``
@@ -380,7 +444,8 @@ class SolveService:
                qp: CanonicalQP,
                deadline_s: Optional[float] = None,
                warm_key: Optional[str] = None,
-               timeout: Optional[float] = None) -> Ticket:
+               timeout: Optional[float] = None,
+               request_id: Optional[str] = None) -> Ticket:
         """Queue one problem. ``deadline_s`` is a relative deadline: a
         request still undispatched that much later completes with
         :class:`DeadlineExpired` instead of occupying a batch slot.
@@ -389,10 +454,57 @@ class SolveService:
         service's ``fingerprint_warm_keys=True``, a request without an
         explicit ``warm_key`` is keyed by its feasible-set fingerprint
         (:func:`porqua_tpu.serve.batcher.problem_fingerprint`) — repeat
-        rebalances over the same polytope warm-start automatically."""
+        rebalances over the same polytope warm-start automatically.
+
+        With a retry policy configured (``SolveService(retry=...)``)
+        the request routes through the :class:`RetryManager` —
+        failures retry with backoff, results are validated, and
+        ``request_id`` keys idempotent resubmission (the same id
+        always returns the same ticket, in flight or resolved).
+        Without one, ``request_id`` raises: accepting it while
+        providing no dedupe would be a silent correctness lie."""
+        # Checked here, not only in _submit_raw: on the retry path a
+        # raw-submit RuntimeError would be swallowed as a retryable
+        # attempt failure and scheduled onto a timer thread that was
+        # never started — the caller's future would simply never
+        # resolve. Both paths must fail loudly and identically.
+        if not self._started:
+            raise RuntimeError("service not started (use `with service:`)")
+        if self._retry is not None:
+            return self._retry.submit(qp, deadline_s=deadline_s,
+                                      warm_key=warm_key, timeout=timeout,
+                                      request_id=request_id)
+        if request_id is not None:
+            raise ValueError(
+                "request_id requires a retry policy "
+                "(SolveService(retry=RetryPolicy(...))): idempotent "
+                "resubmission is tracked by the RetryManager registry")
+        return self._submit_raw(qp, deadline_s=deadline_s,
+                                warm_key=warm_key, timeout=timeout)
+
+    def _submit_raw(self,
+                    qp: CanonicalQP,
+                    deadline_s: Optional[float] = None,
+                    warm_key: Optional[str] = None,
+                    timeout: Optional[float] = None) -> Ticket:
+        """The raw admission path (one queue entry per call — the
+        retry layer fans its attempts into this)."""
         if not self._started:
             raise RuntimeError("service not started (use `with service:`)")
         t0 = time.monotonic()
+        if _faults.enabled():
+            # serve.admission seam: queue_stall sleeps the submitter
+            # (aging every queued deadline behind it); clock_skew
+            # shortens this request's deadline budget as if the
+            # submitter's clock ran ahead of the service's.
+            act = _faults.fire("serve.admission", n=qp.n, m=qp.m)
+            if act is not None:
+                if act.kind == "queue_stall":
+                    time.sleep(float(act.args.get("stall_s", 0.01)))
+                elif act.kind == "clock_skew" and deadline_s is not None:
+                    deadline_s = max(
+                        deadline_s - float(act.args.get("skew_s", 0.0)),
+                        0.0)
         trace_id = (None if self.obs is None
                     else self.obs.spans.new_trace())
         if warm_key is None and self.fingerprint_warm_keys:

@@ -1,0 +1,440 @@
+#!/usr/bin/env python
+"""Chaos suite: the degradation matrix for the online solve service.
+
+Runs the builtin fault-scenario grid (:func:`porqua_tpu.resilience.
+builtin_scenarios`) against a LIVE :class:`SolveService` — classic and
+continuous serve modes, XLA-CPU with two virtual host devices so the
+circuit breaker has a real (primary, fallback) pair — and asserts the
+recovery invariants per scenario:
+
+``zero_wrong_answers``  every result handed to a caller is finite and
+                        matches the offline reference solve (a request
+                        may FAIL under chaos; it may never mis-answer —
+                        the retry layer's validation gate is what makes
+                        ``nan_lanes``/``feed_corrupt`` survivable).
+``fault_fired``         the scenario actually injected (a chaos run
+                        whose faults never fired tests nothing).
+``breaker_cycle``       device-fault scenarios only: the breaker opened
+                        (``breaker_open`` event) AND re-closed
+                        (``breaker_close``), and the service ends the
+                        run un-degraded on its primary device.
+``bounded_failures``    failed requests <= 25% of submissions and the
+                        poisoned-by-design requests all failed.
+``recovered``           after the fault window closes, a clean round of
+                        requests completes with zero errors.
+``expected_events``     the scenario's signature events appeared
+                        (``dispatch_failure``, ``validation_failed``,
+                        ...) and every injected fault logged a
+                        ``fault_injected`` event.
+
+One JSON verdict report (the committed artifact format — see
+``CHAOS_r06.json``) is printed to stdout and optionally written to
+``--report``; exit status is nonzero on ANY invariant violation.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python scripts/chaos_suite.py              # full matrix
+    python scripts/chaos_suite.py --scenarios device_lost,nan_lanes \\
+        --modes classic --report /tmp/chaos.json
+    python scripts/chaos_suite.py --selftest    # 3-scenario CI smoke
+
+``serve_loadgen.py --chaos NAME`` replays one scenario under sustained
+load (throughput/latency view, no invariant gating); this suite is the
+correctness gate. See README "Resilience & chaos testing".
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The breaker degradation matrix needs a real (primary, fallback)
+# device pair; force two virtual host CPU devices BEFORE jax loads
+# (same mechanism as tests/conftest.py).
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=2").strip()
+
+#: Per-scenario driver configuration. ``install`` is when the injector
+#: goes live: "traffic" = after prewarm+warmup (faults hit steady
+#: state), "startup" = before service.start() (probe faults must be
+#: live when the startup check probes the primary). ``device_fault``
+#: scenarios must show the full breaker open -> recover cycle.
+#: ``deadline_s`` arms per-request deadlines (the clock-skew target);
+#: ``feed`` drives the data.feed seam from this suite's submit loop
+#: (the same seam ``loadgen`` compiles in). ``expect_events`` /
+#: ``expect_any_counters`` are the scenario's signature.
+SCENARIOS = {
+    "device_lost": dict(install="traffic", device_fault=True,
+                        expect_events=("dispatch_failure",)),
+    "probe_blackhole": dict(install="startup", device_fault=True,
+                            expect_events=("probe_failure",)),
+    "nan_lanes": dict(install="traffic",
+                      expect_events=("validation_failed",),
+                      expect_any_counters=("validation_failures",)),
+    "compile_storm": dict(install="traffic",
+                          expect_any_counters=("compiles",)),
+    "queue_stall": dict(install="traffic"),
+    "clock_skew": dict(install="traffic", deadline_s=5.0,
+                       expect_any_counters=("expired", "retry_giveups")),
+    "feed_corrupt": dict(install="traffic", feed=True,
+                         expect_any_counters=("validation_failures",)),
+}
+
+MODES = ("classic", "continuous")
+
+#: The CI smoke (`--selftest`): one raising seam, one corruption seam
+#: riding the validation gate, and one continuous-mode run.
+SELFTEST = (("device_lost", "classic"), ("nan_lanes", "classic"),
+            ("queue_stall", "continuous"))
+
+#: Agreement bar for "the answer the caller got is THE answer": the
+#: serve tests pin the batched AOT path to the direct solve at 5e-4.
+WRONG_ANSWER_ATOL = 5e-4
+
+N_REQUESTS = 16        # per round
+CHAOS_ROUNDS = 2       # rounds inside the fault window
+RECOVERY_TIMEOUT_S = 30.0
+RESULT_TIMEOUT_S = 120.0
+
+
+def _build_requests(n, params):
+    """n small well-conditioned QPs (one 8x4 bucket) + their offline
+    reference solutions — the wrong-answer oracle."""
+    import numpy as np
+
+    from porqua_tpu.qp.canonical import CanonicalQP
+    from porqua_tpu.qp.solve import solve_qp
+
+    qps, refs = [], []
+    for seed in range(n):
+        rng = np.random.default_rng(seed)
+        nv, m = 6, 2
+        A = rng.standard_normal((2 * nv, nv))
+        P = A.T @ A / (2 * nv) + np.eye(nv)
+        q = rng.standard_normal(nv)
+        C = np.concatenate([np.ones((1, nv)),
+                            rng.standard_normal((m - 1, nv))])
+        qp = CanonicalQP.build(P, q, C=C, l=np.full(m, -1.0),
+                               u=np.ones(m), lb=np.zeros(nv),
+                               ub=np.ones(nv))
+        qps.append(qp)
+        refs.append(np.asarray(solve_qp(qp, params).x))
+    return qps, refs
+
+
+def _drive_round(service, qps, deadline_s=None, feed=False):
+    """Submit one round; return (n_ok, wrong, failures, poisoned_ok).
+
+    ``wrong`` collects requests that RESOLVED with an answer that is
+    non-finite or disagrees with the reference — the one unforgivable
+    outcome. ``poisoned_ok`` collects poisoned requests that resolved
+    at all (they must fail instead).
+    """
+    import numpy as np
+
+    from porqua_tpu.resilience import faults as _faults
+
+    tickets, poisoned = [], set()
+    for i, (qp, ref) in enumerate(qps):
+        if feed and _faults.enabled():
+            # data.feed seam (suite-side twin of the loadgen seam): a
+            # feed_corrupt directive poisons this request's objective
+            # before submission — through the SAME shared helper the
+            # load generator uses, so the suite asserts on exactly the
+            # corruption loadgen injects (lanes-prefix included).
+            act = _faults.fire("data.feed", i=i)
+            if act is not None and act.kind == "feed_corrupt":
+                qp = _faults.corrupt_feed(qp, act)
+                poisoned.add(i)
+        tickets.append((i, ref, service.submit(qp, deadline_s=deadline_s)))
+    n_ok, wrong, failures, poisoned_ok = 0, [], [], []
+    for i, ref, t in tickets:
+        try:
+            res = service.result(t, timeout=RESULT_TIMEOUT_S)
+        except Exception as exc:  # noqa: BLE001 - a failure IS an outcome
+            failures.append(f"req{i}: {type(exc).__name__}: {exc}")
+            continue
+        x = np.asarray(res.x)
+        if i in poisoned:
+            poisoned_ok.append(i)
+            continue
+        if not np.all(np.isfinite(x)) or \
+                float(np.max(np.abs(x - ref))) > WRONG_ANSWER_ATOL:
+            wrong.append(
+                f"req{i}: max|x-ref|="
+                f"{float(np.max(np.abs(x - ref))):.2e}" if
+                np.all(np.isfinite(x)) else f"req{i}: non-finite x")
+            continue
+        n_ok += 1
+    return n_ok, wrong, failures, poisoned_ok
+
+
+def run_scenario(name, mode, seed, qps, refs, params, ladder, cache,
+                 verbose=False):
+    """One (scenario, mode) cell of the matrix; returns its verdict."""
+    import jax
+
+    from porqua_tpu.obs import Observability
+    from porqua_tpu.resilience import faults as _faults
+    from porqua_tpu.resilience.retry import RetryPolicy
+    from porqua_tpu.serve.metrics import ServeMetrics
+    from porqua_tpu.serve.service import DeviceHealth, SolveService
+
+    cfg = SCENARIOS[name]
+    scenario = _faults.builtin_scenarios(seed=seed)[name]
+    metrics = ServeMetrics()
+    obs = Observability()
+    # Re-point the shared executable cache's sinks at THIS run (the
+    # cache itself is shared across cells so each scenario does not
+    # re-pay the AOT ladder; service.py validates params identity).
+    cache.metrics = metrics
+    cache.events = obs.events
+
+    devices = jax.devices()
+    if len(devices) < 2:  # pragma: no cover - forced above
+        raise RuntimeError("chaos suite needs >= 2 devices for the "
+                           "breaker pair (xla_force_host_platform_"
+                           "device_count)")
+    primary, fallback = devices[-1], devices[0]
+    health = DeviceHealth(primary=primary, fallback=fallback,
+                          failure_threshold=2, probe_timeout_s=10.0,
+                          recovery_interval_s=0.25, metrics=metrics,
+                          events=obs.events)
+    service = SolveService(
+        params=params, ladder=ladder, max_batch=8, max_wait_ms=5.0,
+        queue_capacity=256, metrics=metrics, health=health, obs=obs,
+        continuous=(mode == "continuous"), cache=cache,
+        retry=RetryPolicy(max_attempts=4, backoff_base_s=0.02,
+                          seed=seed))
+
+    injector = _faults.FaultInjector(scenario, metrics=metrics,
+                                     events=obs.events)
+    installed = False
+    round_qps = list(zip(qps, refs))
+    wrong, failures, poisoned_ok = [], [], []
+    try:
+        if cfg["install"] == "startup":
+            _faults.install(injector)
+            installed = True
+        service.start()
+        service.prewarm(qps[0])
+        # One clean warmup round, then reset so counters describe the
+        # chaos + recovery window only.
+        _, w0, f0, _ = _drive_round(service, round_qps)
+        wrong += w0
+        if cfg["install"] == "startup":
+            failures += f0  # startup faults may fail warmup requests
+        metrics.reset_window()
+
+        if cfg["install"] == "traffic":
+            _faults.install(injector)
+            installed = True
+        submitted = 0
+        for _ in range(CHAOS_ROUNDS):
+            _, w, f, p = _drive_round(
+                service, round_qps, deadline_s=cfg.get("deadline_s"),
+                feed=cfg.get("feed", False))
+            wrong += w
+            failures += f
+            poisoned_ok += p
+            submitted += len(round_qps)
+        _faults.uninstall()
+        installed = False
+
+        # Recovery: the fault window is closed; drive clean rounds
+        # until the breaker re-closes (device-fault scenarios) and one
+        # round completes error-free.
+        deadline = time.monotonic() + RECOVERY_TIMEOUT_S
+        recovered = False
+        last_failures = []
+        while time.monotonic() < deadline:
+            _, w, f, _ = _drive_round(service, round_qps)
+            wrong += w
+            last_failures = f
+            submitted += len(round_qps)
+            degraded = service.snapshot()["degraded"]
+            if not f and (not cfg.get("device_fault") or not degraded):
+                recovered = True
+                break
+            time.sleep(0.1)
+        failures += last_failures
+
+        snap = service.snapshot()
+        events = obs.events.events()
+        kinds = {}
+        for e in events:
+            kinds[e["kind"]] = kinds.get(e["kind"], 0) + 1
+        fires = injector.fires()
+
+        invariants = {
+            "zero_wrong_answers": {
+                "ok": not wrong and not poisoned_ok,
+                "detail": (wrong + [f"poisoned req{i} resolved"
+                                    for i in poisoned_ok])[:4],
+            },
+            "fault_fired": {
+                "ok": fires >= 1,
+                "detail": f"{fires} fault(s) fired",
+            },
+            "bounded_failures": {
+                "ok": len(failures) <= 0.25 * max(submitted, 1),
+                "detail": f"{len(failures)}/{submitted} failed "
+                          f"(sample: {failures[:3]})",
+            },
+            "recovered": {
+                "ok": recovered,
+                "detail": ("clean round completed post-window"
+                           if recovered else
+                           f"still failing/degraded after "
+                           f"{RECOVERY_TIMEOUT_S}s: {last_failures[:3]}"),
+            },
+            "expected_events": {
+                "ok": (kinds.get("fault_injected", 0) == fires
+                       and all(kinds.get(k, 0) >= 1
+                               for k in cfg.get("expect_events", ()))
+                       and (not cfg.get("expect_any_counters")
+                            or any(snap.get(c, 0) >= 1 for c in
+                                   cfg["expect_any_counters"]))),
+                "detail": {
+                    "fault_injected_events": kinds.get("fault_injected", 0),
+                    "fires": fires,
+                    "expect_events": {k: kinds.get(k, 0) for k in
+                                      cfg.get("expect_events", ())},
+                    "expect_any_counters": {
+                        c: snap.get(c, 0) for c in
+                        cfg.get("expect_any_counters", ())},
+                },
+            },
+        }
+        if cfg.get("device_fault"):
+            invariants["breaker_cycle"] = {
+                "ok": (kinds.get("breaker_open", 0) >= 1
+                       and kinds.get("breaker_close", 0) >= 1
+                       and not snap["degraded"]),
+                "detail": {"breaker_open": kinds.get("breaker_open", 0),
+                           "breaker_close": kinds.get("breaker_close", 0),
+                           "degraded": snap["degraded"]},
+            }
+
+        ok = all(v["ok"] for v in invariants.values())
+        verdict = {
+            "scenario": name,
+            "mode": mode,
+            "ok": ok,
+            "invariants": invariants,
+            "faults_injected": fires,
+            "fault_log": injector.log()[:16],
+            "counters": {k: snap[k] for k in (
+                "submitted", "completed", "failed", "expired", "rejected",
+                "retries", "hedges_fired", "hedges_won",
+                "resumed_requests", "retry_giveups",
+                "validation_failures", "faults_injected", "compiles",
+                "dispatch_failures", "probe_failures",
+                "device_switches")},
+            "event_kinds": kinds,
+        }
+        if verbose:
+            state = "ok  " if ok else "FAIL"
+            bad = [k for k, v in invariants.items() if not v["ok"]]
+            print(f"  {state} {name:<16} {mode:<10} "
+                  f"faults={fires} failed={len(failures)}"
+                  + (f"  violated: {', '.join(bad)}" if bad else ""),
+                  file=sys.stderr)
+        return verdict
+    finally:
+        if installed:
+            _faults.uninstall()
+        service.stop()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--scenarios", default=None,
+                    help="comma-separated subset (default: all of "
+                         f"{', '.join(SCENARIOS)})")
+    ap.add_argument("--modes", default=",".join(MODES),
+                    help="comma-separated serve modes (classic,continuous)")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="scenario seed (replays are deterministic)")
+    ap.add_argument("--report", default=None,
+                    help="write the JSON verdict report here too")
+    ap.add_argument("--selftest", action="store_true",
+                    help="3-scenario CI smoke (device_lost/classic, "
+                         "nan_lanes/classic, queue_stall/continuous)")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from porqua_tpu.qp.solve import SolverParams
+    from porqua_tpu.serve.bucketing import BucketLadder, ExecutableCache
+
+    params = SolverParams(max_iter=500, eps_abs=1e-5, eps_rel=1e-5,
+                          polish=False, check_interval=25)
+    ladder = BucketLadder(n_rungs=(8,), m_rungs=(4,))
+
+    if args.selftest:
+        cells = list(SELFTEST)
+    else:
+        names = (list(SCENARIOS) if args.scenarios is None
+                 else [s.strip() for s in args.scenarios.split(",") if s])
+        modes = [m.strip() for m in args.modes.split(",") if m]
+        for s in names:
+            if s not in SCENARIOS:
+                ap.error(f"unknown scenario {s!r} (known: "
+                         f"{', '.join(SCENARIOS)})")
+        for m in modes:
+            if m not in MODES:
+                ap.error(f"unknown mode {m!r} (known: {', '.join(MODES)})")
+        cells = [(s, m) for s in names for m in modes]
+
+    print(f"chaos suite: {len(cells)} cell(s), seed {args.seed}",
+          file=sys.stderr)
+    qps, refs = _build_requests(N_REQUESTS, params)
+    # One executable cache shared across every cell (and both serve
+    # modes — classic and continuous entries key separately), so the
+    # matrix pays the AOT ladder once, not per scenario.
+    cache = ExecutableCache(params)
+
+    t0 = time.time()
+    results = []
+    for name, mode in cells:
+        results.append(run_scenario(name, mode, args.seed, qps, refs,
+                                    params, ladder, cache, verbose=True))
+    report = {
+        "suite": "chaos",
+        "selftest": bool(args.selftest),
+        "seed": args.seed,
+        "backend": jax.devices()[0].platform,
+        "n_devices": len(jax.devices()),
+        "wrong_answer_atol": WRONG_ANSWER_ATOL,
+        "elapsed_s": round(time.time() - t0, 1),
+        "cells": results,
+        "ok": all(r["ok"] for r in results),
+    }
+    print(json.dumps(report))
+    if args.report:
+        with open(args.report, "w") as f:
+            json.dump(report, f, indent=1)
+        print(f"report -> {args.report}", file=sys.stderr)
+    if not report["ok"]:
+        bad = [f"{r['scenario']}/{r['mode']}" for r in results
+               if not r["ok"]]
+        print(f"chaos suite: INVARIANT VIOLATIONS in {', '.join(bad)}",
+              file=sys.stderr)
+        return 1
+    print(f"chaos suite: ok ({len(cells)} cells, "
+          f"{report['elapsed_s']}s)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -62,6 +62,20 @@ def _selftest() -> int:
     obs.events.emit("compile", bucket="32x8", slots=8, seconds=0.5)
     obs.events.emit("breaker_open", "error", primary="tpu:0",
                     fallback="cpu:0", failures=2)
+    # A synthetic chaos round-trip: injected faults answered by the
+    # recovery machinery (the faults/recovery section renders both
+    # sides, and the breaker-state line must say it re-closed).
+    for _ in range(2):
+        obs.events.emit("fault_injected", "warn", seam="serve.dispatch",
+                        fault_kind="device_lost", scenario="device_lost")
+    obs.events.emit("retry_scheduled", "warn", request_id="r1",
+                    attempt=2, delay_s=0.02,
+                    error="SolveError: injected device loss")
+    obs.events.emit("retry_giveup", "error", request_id="r2",
+                    reason="deadline", attempts=2, hedges=0,
+                    error="DeadlineExpired: budget spent")
+    obs.events.emit("hedge_fired", "info", request_id="r3", attempt=1)
+    obs.events.emit("breaker_close", "info", primary="tpu:0")
 
     trace = obs.spans.chrome_trace()
     cov = coverage_stats(trace)
@@ -90,7 +104,9 @@ def _selftest() -> int:
     text = render_report(trace=trace, events=events, snapshot=snapshot)
     for needle in ("stage waterfall", "queue_wait", "span coverage",
                    "convergence rings", "breaker_open",
-                   "latency / throughput"):
+                   "latency / throughput", "faults / recovery",
+                   "injected serve.dispatch", "retry_scheduled",
+                   "1 open / 1 close -> re-closed"):
         assert needle in text, f"selftest: {needle!r} missing from report"
     print(text)
     print("\nobs_report selftest: ok")

@@ -6,17 +6,22 @@ Usage:
 
 Defaults to scanning ``porqua_tpu/`` — every package subtree,
 including the observability stack ``porqua_tpu/obs/``, the compaction
-driver ``porqua_tpu/compaction.py``, and the continuous batcher
-``porqua_tpu/serve/continuous.py`` (all of which must scan clean with
-zero suppressions, same bar as the solver) — with every AST rule
-(GC001-GC006) plus the trace-time jaxpr contracts (GC101-GC103)
-against the real batch entry points on the XLA-CPU backend: default
-solver params, the convergence-ring telemetry variant
-(``SolverParams(ring_size>0)``), the compaction step-and-repack
-program (dense + factored — the machine-checked proof the repack
-introduces no host syncs/transfers), and the continuous-batching
-admit/step/finalize triple. Exit status: 0 clean, 1 findings,
-2 internal/usage error.
+driver ``porqua_tpu/compaction.py``, the continuous batcher
+``porqua_tpu/serve/continuous.py``, and the resilience plane
+``porqua_tpu/resilience/`` (all of which must scan clean with zero
+suppressions, same bar as the solver) — with every AST rule
+(GC001-GC007; GC007 enforces the ``if faults.enabled():`` guard on
+every fault-injection seam) plus the trace-time jaxpr contracts
+(GC101-GC104) against the real batch entry points on the XLA-CPU
+backend: default solver params, the convergence-ring telemetry
+variant (``SolverParams(ring_size>0)``), the compaction
+step-and-repack program (dense + factored — the machine-checked proof
+the repack introduces no host syncs/transfers), the
+continuous-batching admit/step/finalize triple, and the GC104
+fault-injector jaxpr-identity contract (solve/serve programs traced
+with a live injector must be string-identical to the bare traces —
+the "bit-identical when disabled" proof). Exit status: 0 clean,
+1 findings, 2 internal/usage error.
 
 Options:
     --format {text,json}   output format (default text)
@@ -80,7 +85,7 @@ def main(argv=None) -> int:
     findings = scan_paths(paths, rules=rules)
 
     if not args.no_contracts and (
-            rules is None or rules & {"GC101", "GC102", "GC103"}):
+            rules is None or rules & {"GC101", "GC102", "GC103", "GC104"}):
         try:
             import jax
 

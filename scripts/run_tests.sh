@@ -31,6 +31,18 @@ else
     fail=1
 fi
 
+# chaos suite smoke: 3 fault scenarios against a live SolveService
+# (classic + continuous) with the recovery invariants asserted — any
+# invariant violation exits nonzero (README "Resilience & chaos
+# testing"; the full degradation matrix: scripts/chaos_suite.py).
+if out=$(timeout 600 python scripts/chaos_suite.py --selftest 2>&1); then
+    echo "OK   chaos_suite --selftest: $(echo "$out" | tail -1)"
+else
+    echo "FAIL chaos_suite --selftest:"
+    echo "$out"
+    fail=1
+fi
+
 for f in tests/test_*.py; do
     for attempt in 1 2; do
         out=$(timeout 1800 python -m pytest "$f" -q --no-header 2>&1)

@@ -20,6 +20,9 @@ Examples::
     python scripts/serve_loadgen.py --warm-keys --jsonl serve_metrics.jsonl
     python scripts/serve_loadgen.py --trace-out trace.json \\
         --events-out events.jsonl --rings 16   # then: scripts/obs_report.py
+    python scripts/serve_loadgen.py --chaos device_lost \\
+        --events-out chaos.jsonl   # one fault scenario under load;
+                                   # the full matrix: scripts/chaos_suite.py
 
 Prints one JSON report line on stdout (diagnostics on stderr), in the
 same one-line-artifact style as ``bench.py``.
@@ -79,6 +82,31 @@ def main() -> int:
                          "segments as MAX_ITER + polish fallback "
                          "(default: the solver's max_iter expressed in "
                          "segments)")
+    ap.add_argument("--chaos", default=None, metavar="NAME",
+                    help="install a builtin fault scenario for the "
+                         "measured phase (porqua_tpu.resilience."
+                         "builtin_scenarios: device_lost, "
+                         "probe_blackhole, nan_lanes, compile_storm, "
+                         "queue_stall, clock_skew, feed_corrupt); "
+                         "enables the retry policy unless --no-retry")
+    ap.add_argument("--chaos-seed", type=int, default=0,
+                    help="scenario seed (replays are deterministic "
+                         "per seed)")
+    ap.add_argument("--retry", action="store_true",
+                    help="route requests through the recovery layer "
+                         "(RetryPolicy defaults: 3 attempts, exp "
+                         "backoff + jitter, result validation) even "
+                         "without --chaos")
+    ap.add_argument("--no-retry", action="store_true",
+                    help="opt out of the retry policy --chaos would "
+                         "otherwise imply: measure raw (unrecovered) "
+                         "fault behavior — failed/corrupted requests "
+                         "count as errors instead of retrying")
+    ap.add_argument("--hedge-after-s", type=float, default=None,
+                    metavar="S",
+                    help="fire one hedged duplicate for any request "
+                         "still unresolved S seconds after submission "
+                         "(implies --retry)")
     ap.add_argument("--seed", type=int, default=5)
     ap.add_argument("--factor", action="store_true",
                     help="carry the low-rank objective factor (Pf = X) "
@@ -96,16 +124,30 @@ def main() -> int:
         n_requests, n_assets=n_assets, window=args.window, seed=args.seed,
         factor=args.factor)
 
+    retry = None
+    if args.retry or args.hedge_after_s is not None:
+        if args.no_retry:
+            ap.error("--no-retry contradicts --retry/--hedge-after-s")
+        from porqua_tpu.resilience.retry import RetryPolicy
+
+        retry = RetryPolicy(hedge_after_s=args.hedge_after_s)
+
     report = run_loadgen(
         requests, mode=args.mode, rate=args.rate, inflight=args.inflight,
         max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
         warm_keys=args.warm_keys, deadline_s=args.deadline_s,
         jsonl_path=args.jsonl, trace_out=args.trace_out,
         events_out=args.events_out, ring_size=args.rings,
-        continuous=args.continuous, segment_budget=args.segment_budget)
+        continuous=args.continuous, segment_budget=args.segment_budget,
+        retry=retry, chaos=args.chaos, chaos_seed=args.chaos_seed,
+        no_retry=args.no_retry)
     report["workload"] = args.workload
     print(json.dumps(report))
-    return 0 if report["errors"] == 0 else 1
+    # Under --chaos, errors are the scenario doing its job (failed
+    # requests are an allowed outcome; wrong answers are not, and the
+    # validation gate converts those to errors) — the invariant
+    # checking lives in scripts/chaos_suite.py.
+    return 0 if (report["errors"] == 0 or args.chaos) else 1
 
 
 if __name__ == "__main__":

@@ -1,0 +1,673 @@
+"""Resilience plane (porqua_tpu.resilience): deterministic fault
+injection, retry/hedging recovery policies, crash-resume backtests.
+
+Three layers of coverage: (1) the injector itself — seam/kind typing,
+per-spec counters, seeded deterministic replay, exclusive install;
+(2) the recovery paths it drives — breaker-riding device-fault retry,
+NaN-validation withholding, deadline give-up, idempotent resubmission
+by request id, hedging, the injectable breaker clock; (3) crash-resume
+bit-parity for the turnover-coupled scan backtest (a run killed at a
+seeded segment boundary and resumed equals an uninterrupted run, bit
+for bit). The GC007 guard lint and GC104 jaxpr-identity contract are
+exercised here too (seeded violation + shipped-tree pass).
+"""
+
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from porqua_tpu.qp.canonical import CanonicalQP, stack_qps
+from porqua_tpu.qp.solve import SolverParams
+from porqua_tpu.resilience import faults
+from porqua_tpu.resilience.retry import RetryManager, RetryPolicy, validate_result
+from porqua_tpu.serve import BucketLadder, DeviceHealth, ServeMetrics, SolveService
+
+PARAMS = SolverParams(max_iter=500, eps_abs=1e-5, eps_rel=1e-5,
+                      polish=False, check_interval=25)
+LADDER = BucketLadder(n_rungs=(8, 16), m_rungs=(4, 8))
+
+
+def make_qp(n=6, m=2, seed=0):
+    rng = np.random.default_rng(seed)
+    A = rng.standard_normal((2 * n, n))
+    P = A.T @ A / (2 * n) + np.eye(n)
+    q = rng.standard_normal(n)
+    C = np.concatenate([np.ones((1, n)), rng.standard_normal((m - 1, n))])
+    return CanonicalQP.build(P, q, C=C, l=np.full(m, -1.0), u=np.ones(m),
+                             lb=np.zeros(n), ub=np.ones(n))
+
+
+def service(**kw):
+    kw.setdefault("params", PARAMS)
+    kw.setdefault("ladder", LADDER)
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("max_wait_ms", 5.0)
+    return SolveService(**kw)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_injector():
+    """A test that leaks its injector would perturb every later test
+    in the process — fail loudly instead."""
+    assert not faults.enabled(), "fault injector leaked into this test"
+    yield
+    leaked = faults.enabled()
+    faults.uninstall()
+    assert not leaked, "test leaked an installed fault injector"
+
+
+# ---------------------------------------------------------------------------
+# injector core
+# ---------------------------------------------------------------------------
+
+def test_fault_spec_dsl_typing():
+    mk = faults.FaultSpec.make
+    with pytest.raises(ValueError, match="unknown seam"):
+        mk("serve.nonsense", "device_lost")
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        mk("serve.dispatch", "gremlins")
+    with pytest.raises(ValueError, match="cannot target seam"):
+        mk("serve.admission", "device_lost")
+    with pytest.raises(ValueError, match="count"):
+        mk("serve.dispatch", "device_lost", count=0)
+    with pytest.raises(ValueError, match="p must be"):
+        mk("serve.dispatch", "device_lost", p=0.0)
+
+
+def test_injector_start_count_and_exhaustion():
+    sc = faults.Scenario("t", (faults.FaultSpec.make(
+        "serve.result", "nan_lanes", start=1, count=2, lanes=3),))
+    inj = faults.FaultInjector(sc)
+    hits = [inj.fire("serve.result") for _ in range(5)]
+    # hit 0 skipped (start=1), hits 1-2 fire, 3-4 quiet (count spent)
+    assert [h is None for h in hits] == [True, False, False, True, True]
+    assert hits[1].kind == "nan_lanes" and hits[1].args["lanes"] == 3
+    assert inj.fires() == 2 and inj.fires("serve.result") == 2
+    assert inj.exhausted()
+    assert [e["hit"] for e in inj.log()] == [1, 2]
+
+
+def test_injector_seeded_replay_is_deterministic():
+    """Same scenario seed -> identical fire sequence (the p<1 draws
+    come from a per-spec stream keyed by the rule identity alone)."""
+    def run(seed):
+        sc = faults.Scenario("t", (faults.FaultSpec.make(
+            "serve.admission", "clock_skew", count=50, p=0.5,
+            skew_s=1.0),), seed=seed)
+        inj = faults.FaultInjector(sc)
+        return [inj.fire("serve.admission") is not None
+                for _ in range(64)]
+
+    assert run(7) == run(7)
+    assert run(7) != run(8)  # 2^-64 flake odds
+
+
+def test_install_is_exclusive_and_context_managed():
+    sc = faults.Scenario("a", (faults.FaultSpec.make(
+        "serve.dispatch", "device_lost"),))
+    with faults.active(sc):
+        assert faults.enabled()
+        with pytest.raises(RuntimeError, match="already installed"):
+            faults.install(faults.FaultInjector(sc))
+    assert not faults.enabled()
+    assert faults.fire("serve.dispatch") is None  # disabled = no-op
+
+
+def test_raising_kinds_raise():
+    with faults.active(faults.Scenario("a", (
+            faults.FaultSpec.make("serve.dispatch", "device_lost"),
+            faults.FaultSpec.make("backtest.chunk", "crash")))):
+        with pytest.raises(faults.InjectedFault):
+            faults.fire("serve.dispatch")
+        with pytest.raises(faults.InjectedCrash):
+            faults.fire("backtest.chunk")
+    # InjectedCrash must NOT be containable by `except Exception` —
+    # that is the whole point of modeling a SIGKILL with it.
+    assert not issubclass(faults.InjectedCrash, Exception)
+
+
+def test_fault_clock():
+    clock = faults.FaultClock(start=10.0)
+    assert clock() == 10.0
+    assert clock.advance(2.5) == 12.5
+    assert clock() == 12.5
+
+
+# ---------------------------------------------------------------------------
+# recovery policies
+# ---------------------------------------------------------------------------
+
+def test_validate_result_gate():
+    class R:
+        def __init__(self, x, prim=0.0, dual=0.0, obj=1.0):
+            self.x, self.prim_res, self.dual_res, self.obj_val = \
+                x, prim, dual, obj
+
+    assert validate_result(R(np.ones(3))) is None
+    assert "primal" in validate_result(R(np.array([1.0, np.nan])))
+    assert "prim_res" in validate_result(R(np.ones(2), prim=np.inf))
+
+
+def test_backoff_jitter_bounded_and_growing():
+    pol = RetryPolicy(backoff_base_s=0.1, backoff_mult=2.0, jitter=0.5)
+    rng = np.random.default_rng(0)
+    d1 = [pol.backoff_s(1, rng) for _ in range(100)]
+    d3 = [pol.backoff_s(3, rng) for _ in range(100)]
+    assert all(0.05 <= d <= 0.15 for d in d1)
+    assert all(0.2 <= d <= 0.6 for d in d3)
+    with pytest.raises(ValueError):
+        RetryPolicy(max_attempts=0)
+    with pytest.raises(ValueError):
+        RetryPolicy(jitter=1.0)
+
+
+def test_retry_recovers_injected_device_fault():
+    """device_lost faults exhaust the dispatch containment (single-
+    device health: nothing to fall to), the request-level retry
+    re-drives the request, and the caller gets the right answer —
+    counted as retries + one resumed request."""
+    import jax
+
+    dev = jax.devices("cpu")[0]
+    health = DeviceHealth(primary=dev, fallback=dev,
+                          failure_threshold=2)
+    with service(health=health,
+                 retry=RetryPolicy(max_attempts=4,
+                                   backoff_base_s=0.01)) as svc:
+        with faults.active(faults.Scenario("dl", (
+                faults.FaultSpec.make("serve.dispatch", "device_lost",
+                                      count=3),)), metrics=svc.metrics):
+            res = svc.solve(make_qp(seed=1), timeout=120)
+    assert res.found
+    snap = svc.snapshot()
+    assert snap["retries"] >= 1
+    assert snap["resumed_requests"] == 1
+    assert snap["dispatch_failures"] == 3
+    assert snap["retry_giveups"] == 0
+
+
+def test_retry_gives_up_after_max_attempts():
+    import jax
+
+    from porqua_tpu.serve import SolveError
+
+    dev = jax.devices("cpu")[0]
+    health = DeviceHealth(primary=dev, fallback=dev,
+                          failure_threshold=2)
+    with service(health=health,
+                 retry=RetryPolicy(max_attempts=2,
+                                   backoff_base_s=0.01)) as svc:
+        with faults.active(faults.Scenario("dl", (
+                faults.FaultSpec.make("serve.dispatch", "device_lost",
+                                      count=50),))):
+            with pytest.raises(SolveError):
+                svc.solve(make_qp(seed=2), timeout=120)
+    snap = svc.snapshot()
+    assert snap["retry_giveups"] == 1
+    assert snap["completed"] == 0
+
+
+def test_nan_lane_corruption_withheld_and_retried():
+    """An injected serve.result NaN corruption must never reach the
+    caller: validation withholds it, the retry resubmits, the second
+    attempt is clean."""
+    with service(retry=RetryPolicy(max_attempts=3,
+                                   backoff_base_s=0.01)) as svc:
+        with faults.active(faults.Scenario("nan", (
+                faults.FaultSpec.make("serve.result", "nan_lanes",
+                                      count=1, lanes=1),)),
+                           metrics=svc.metrics):
+            res = svc.solve(make_qp(seed=3), timeout=120)
+    assert res.found and np.all(np.isfinite(res.x))
+    snap = svc.snapshot()
+    assert snap["validation_failures"] == 1
+    assert snap["retries"] == 1
+    assert snap["resumed_requests"] == 1
+
+
+def test_idempotent_resubmission_no_double_resolve():
+    """One request id, one future, one resolution: resubmitting a
+    RESOLVED id returns the same ticket/result and moves no counters;
+    resubmitting an in-flight id returns the same future."""
+    qp = make_qp(seed=4)
+    with service(retry=RetryPolicy()) as svc:
+        t1 = svc.submit(qp, request_id="r-1")
+        t1b = svc.submit(qp, request_id="r-1")   # in flight: same future
+        assert t1b.future is t1.future
+        res1 = svc.result(t1, timeout=120)
+        base = svc.snapshot()
+
+        t2 = svc.submit(qp, request_id="r-1")    # resolved: same future
+        assert t2.future is t1.future
+        assert svc.result(t2, timeout=1) is res1
+        snap = svc.snapshot()
+        assert snap["submitted"] == base["submitted"]
+        assert snap["completed"] == base["completed"]
+        assert snap["resumed_requests"] == base["resumed_requests"]
+        assert svc._retry.entry_stats("r-1")["attempts"] == 1
+
+        # A different id is a different request (no false dedupe).
+        res2 = svc.result(svc.submit(qp, request_id="r-2"), timeout=120)
+        assert res2 is not res1
+    assert svc.snapshot()["completed"] == base["completed"] + 1
+
+
+def test_request_id_without_retry_policy_raises():
+    with service() as svc:
+        with pytest.raises(ValueError, match="retry policy"):
+            svc.submit(make_qp(), request_id="r-1")
+
+
+def test_submit_unstarted_service_raises_with_retry_policy():
+    """The retry path must fail an unstarted submit as loudly as the
+    raw path: swallowed into a retryable attempt, the RuntimeError
+    would schedule onto a never-started timer thread and the caller's
+    future would simply never resolve."""
+    svc = service(retry=RetryPolicy())
+    try:
+        with pytest.raises(RuntimeError, match="not started"):
+            svc.submit(make_qp())
+    finally:
+        svc.stop()
+
+
+def test_stop_fails_unresolved_retry_futures():
+    """stop() abandons scheduled retries — the affected futures must
+    fail immediately (retry_giveups, reason=stopped), not leave the
+    caller blocked forever on a timer that will never fire."""
+    import time as _time
+
+    import jax
+
+    from porqua_tpu.serve import SolveError
+
+    dev = jax.devices("cpu")[0]
+    health = DeviceHealth(primary=dev, fallback=dev,
+                          failure_threshold=2)
+    svc = service(health=health,
+                  retry=RetryPolicy(max_attempts=10,
+                                    backoff_base_s=30.0)).start()
+    try:
+        with faults.active(faults.Scenario("dl", (
+                faults.FaultSpec.make("serve.dispatch", "device_lost",
+                                      count=50),))):
+            ticket = svc.submit(make_qp(seed=7))
+            deadline = _time.monotonic() + 30
+            while (svc.snapshot()["retries"] < 1
+                   and _time.monotonic() < deadline):
+                _time.sleep(0.02)
+            assert svc.snapshot()["retries"] >= 1
+            svc.stop()
+            with pytest.raises(SolveError, match="stopped"):
+                svc.result(ticket, timeout=5)
+    finally:
+        svc.stop()
+    assert svc.snapshot()["retry_giveups"] == 1
+
+
+class _FakeRawService:
+    """Raw-submit stand-in: records each inner attempt's future so a
+    test resolves attempts by hand without a real dispatch loop."""
+
+    def __init__(self):
+        self.inner = []
+
+    def _submit_raw(self, qp, deadline_s=None, warm_key=None,
+                    timeout=None):
+        import time as _time
+
+        from concurrent.futures import Future
+
+        from porqua_tpu.serve.service import Ticket
+
+        fut = Future()
+        self.inner.append((qp, fut))
+        return Ticket(future=fut, submitted=_time.monotonic())
+
+
+def _fake_solution():
+    import types
+
+    return types.SimpleNamespace(x=np.ones(3), prim_res=0.0,
+                                 dual_res=0.0, obj_val=1.0)
+
+
+def test_registry_eviction_spares_inflight_entries():
+    """LRU eviction must only drop RESOLVED entries: evicting an
+    in-flight id would fork it (a duplicate submit registers a second
+    future for the same request) and orphan the original future at
+    stop(), which only fails entries still in the registry."""
+    raw = _FakeRawService()
+    mgr = RetryManager(raw, RetryPolicy(registry_capacity=2),
+                       ServeMetrics())
+    mgr.start()
+    try:
+        live = mgr.submit(make_qp(), request_id="live")  # stays in flight
+        for i in range(3):
+            t = mgr.submit(make_qp(), request_id=f"r-{i}")
+            raw.inner[-1][1].set_result(_fake_solution())
+            t.future.result(timeout=5)
+        # "live" is the LRU-oldest, but unresolved: resubmission must
+        # still dedupe onto the original future (not a fresh entry).
+        assert mgr.submit(make_qp(), request_id="live").future \
+            is live.future
+        with mgr._lock:
+            assert "live" in mgr._entries
+            assert len(mgr._entries) <= 2 + 1  # capacity + the in-flight
+        raw.inner[0][1].set_result(_fake_solution())
+        live.future.result(timeout=5)
+    finally:
+        mgr.stop()
+
+
+def test_resolved_entry_drops_problem_payload():
+    """Resolution keeps the idempotency record (id -> future) but must
+    drop the QP payload: up to registry_capacity retained problem
+    matrices is real memory on real sizes, and no attempt is ever
+    issued for a resolved entry."""
+    raw = _FakeRawService()
+    mgr = RetryManager(raw, RetryPolicy(), ServeMetrics())
+    mgr.start()
+    try:
+        t = mgr.submit(make_qp(), request_id="rid")
+        with mgr._lock:
+            assert mgr._entries["rid"].qp is not None
+        raw.inner[-1][1].set_result(_fake_solution())
+        res = t.future.result(timeout=5)
+        with mgr._lock:
+            entry = mgr._entries["rid"]
+            assert entry.resolved and entry.qp is None
+        # The payload-free entry still dedupes to the same resolution.
+        t2 = mgr.submit(make_qp(), request_id="rid")
+        assert t2.future is t.future
+        assert t2.future.result(timeout=1) is res
+    finally:
+        mgr.stop()
+
+
+def test_gc007_orelse_and_negated_guard_rejected(tmp_path):
+    """A fire() in the else branch of an enabled() check, or under
+    `if not enabled():`, is exactly the disabled-path seam GC007
+    exists to catch — the guard must be the If BODY under a
+    non-negated test."""
+    from porqua_tpu.analysis.lint import scan_paths
+
+    path = tmp_path / "serve" / "bad3.py"
+    path.parent.mkdir(parents=True)
+    path.write_text(textwrap.dedent("""\
+        from porqua_tpu.resilience import faults as _faults
+
+        def dispatch(batch):
+            if _faults.enabled():
+                pass
+            else:
+                _faults.fire("serve.dispatch")      # orelse: flagged
+            if not _faults.enabled():
+                _faults.fire("serve.dispatch")      # negated: flagged
+            if _faults.enabled():
+                _faults.fire("serve.dispatch")      # guarded: clean
+            return batch
+        """))
+    hits = [(f.rule, f.line) for f in scan_paths([str(path)],
+                                                 rules={"GC007"})]
+    assert hits == [("GC007", 7), ("GC007", 9)]
+
+
+def test_hedge_fires_for_straggling_request():
+    """A request still unresolved past hedge_after_s fires exactly one
+    duplicate; the caller still gets exactly one (valid) result."""
+    with service(max_wait_ms=250.0,
+                 retry=RetryPolicy(hedge_after_s=0.04,
+                                   backoff_base_s=0.01)) as svc:
+        # One lone request: the batcher's age trigger holds it ~250 ms,
+        # far past the hedge timer.
+        res = svc.solve(make_qp(seed=5), timeout=120)
+    assert res.found
+    snap = svc.snapshot()
+    assert snap["hedges_fired"] == 1
+    assert snap["retry_giveups"] == 0
+
+
+def test_breaker_reclose_on_injected_clock():
+    """The breaker's open->half-open->close cycle replayed on a
+    stepped FaultClock: no wall-clock waits, fully deterministic
+    timing decisions (the recovery probe still runs on its thread)."""
+    import jax
+    import time as _time
+
+    devices = jax.devices()
+    assert len(devices) >= 2  # conftest forces 8 virtual devices
+    clock = faults.FaultClock()
+    probe_ok = [False]
+    metrics = ServeMetrics()
+    health = DeviceHealth(primary=devices[-1], fallback=devices[0],
+                          probe_fn=lambda dev: probe_ok[0],
+                          failure_threshold=2, probe_timeout_s=5.0,
+                          recovery_interval_s=60.0, metrics=metrics,
+                          clock=clock)
+    assert health.record_failure(RuntimeError("boom")) is True
+    assert health.record_failure(RuntimeError("boom")) is True  # trips
+    assert health.degraded
+    # Inside the recovery interval: no re-probe, fallback served.
+    assert health.device() is health.fallback
+    # Step PAST the interval on the fake clock; the next device() call
+    # schedules the half-open probe. First probe fails -> re-armed.
+    clock.advance(61.0)
+    health.device()
+    deadline = _time.monotonic() + 5.0
+    while health._recovery_inflight and _time.monotonic() < deadline:
+        _time.sleep(0.01)
+    assert health.degraded  # probe said no; open window re-armed
+    # Re-armed at the fake now: another 61 fake seconds, probe now ok.
+    probe_ok[0] = True
+    clock.advance(61.0)
+    health.device()
+    deadline = _time.monotonic() + 5.0
+    while health.degraded and _time.monotonic() < deadline:
+        _time.sleep(0.01)
+    assert not health.degraded
+    assert health.device() is health.primary
+
+
+def test_probe_fail_seam_trips_breaker_without_device():
+    """health.probe seam: a probe_fail directive makes the startup
+    check trip the breaker with no device involvement at all."""
+    import jax
+
+    devices = jax.devices()
+    metrics = ServeMetrics()
+    health = DeviceHealth(primary=devices[-1], fallback=devices[0],
+                          failure_threshold=2, recovery_interval_s=3600.0,
+                          metrics=metrics)
+    with faults.active(faults.Scenario("probe", (
+            faults.FaultSpec.make("health.probe", "probe_fail",
+                                  count=2),))):
+        health.startup_check()
+    assert health.degraded
+    assert metrics.snapshot()["probe_failures"] == 2
+
+
+# ---------------------------------------------------------------------------
+# crash-resume bit-parity (checkpointed scan backtest)
+# ---------------------------------------------------------------------------
+
+def _w_init_sha(w_init, dtype):
+    """The scan-checkpoint run-identity fingerprint for a padded
+    w_init (mirrors solve_scan_l1_checkpointed's key derivation)."""
+    from porqua_tpu.checkpoint import _array_fingerprint
+
+    n = len(w_init)
+    w0 = jnp.zeros(n, dtype).at[:n].set(jnp.asarray(w_init, dtype)[:n])
+    return _array_fingerprint(w0)
+
+
+def _scan_problem(n=6, n_dates=6, seed=11):
+    rng = np.random.default_rng(seed)
+    qps = []
+    for _ in range(n_dates):
+        X = rng.standard_normal((60, n)) * 0.01
+        P = 2 * X.T @ X + 1e-6 * np.eye(n)
+        q = -0.02 * rng.random(n)
+        qps.append(CanonicalQP.build(
+            P, q, C=np.ones((1, n)), l=np.ones(1), u=np.ones(1),
+            lb=np.zeros(n), ub=np.ones(n), dtype=jnp.float64))
+    return stack_qps(qps), np.full(n, 1.0 / n)
+
+
+def test_scan_checkpoint_crash_resume_bit_parity(tmp_path):
+    """The acceptance invariant: a scan backtest killed at a seeded
+    random segment boundary and resumed from checkpoint produces
+    BIT-identical results to an uninterrupted run (and both match the
+    unsegmented scan exactly)."""
+    from porqua_tpu.batch import FIXED_UNIVERSE, solve_scan_l1
+    from porqua_tpu.checkpoint import solve_scan_l1_checkpointed
+
+    params = SolverParams(max_iter=2000, eps_abs=1e-7, eps_rel=1e-7)
+    qp, w_init = _scan_problem()
+    tc, seg = 0.01, 2
+
+    golden, info = solve_scan_l1_checkpointed(
+        qp, 6, w_init, tc, str(tmp_path / "golden"), params=params,
+        segment_size=seg, universes=FIXED_UNIVERSE)
+    assert info["resumed_segments"] == 0
+    assert info["total_segments"] == 3
+
+    # Kill at a seeded random boundary (after that segment persisted).
+    k = int(np.random.default_rng(0).integers(0, 2))
+    crash = faults.Scenario("crash", (faults.FaultSpec.make(
+        "backtest.chunk", "crash", start=k, count=1),))
+    with faults.active(crash):
+        with pytest.raises(faults.InjectedCrash):
+            solve_scan_l1_checkpointed(
+                qp, 6, w_init, tc, str(tmp_path / "crashed"),
+                params=params, segment_size=seg,
+                universes=FIXED_UNIVERSE)
+
+    resumed, info2 = solve_scan_l1_checkpointed(
+        qp, 6, w_init, tc, str(tmp_path / "crashed"), params=params,
+        segment_size=seg, universes=FIXED_UNIVERSE)
+    assert info2["resumed_segments"] == k + 1
+
+    for f in golden._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(golden, f)),
+            np.asarray(getattr(resumed, f)), err_msg=f)
+
+    # And the segmented run IS the unsegmented scan, bit for bit (the
+    # split executes the identical per-date step on identical values).
+    uncut = solve_scan_l1(qp, 6, w_init, tc, params=params,
+                          universes=FIXED_UNIVERSE)
+    np.testing.assert_array_equal(np.asarray(golden.x),
+                                  np.asarray(uncut.x))
+
+
+def test_scan_checkpoint_requires_carry_for_resume(tmp_path):
+    """A crash BETWEEN the chunk write and the carry write must roll
+    that segment back (resume from an unreconstructable boundary would
+    chain from the wrong state)."""
+    from porqua_tpu.batch import FIXED_UNIVERSE
+    from porqua_tpu.checkpoint import (
+        CheckpointManager,
+        solve_scan_l1_checkpointed,
+    )
+
+    params = SolverParams(max_iter=2000, eps_abs=1e-7, eps_rel=1e-7)
+    qp, w_init = _scan_problem()
+    d = str(tmp_path / "run")
+    golden, _ = solve_scan_l1_checkpointed(
+        qp, 6, w_init, 0.01, d, params=params, segment_size=2,
+        universes=FIXED_UNIVERSE)
+
+    # Re-attach to the run directory (create() on an existing manifest
+    # validates the run identity and returns the manager).
+    mgr = CheckpointManager.create(
+        d, [str(i) for i in range(6)], 2, params, dtype=jnp.float64,
+        has_l1=True,
+        extra={"kind": "scan_l1", "transaction_cost": 0.01,
+               "n_assets": 6,
+               "w_init_sha": _w_init_sha(w_init, jnp.float64)})
+    assert mgr.completed_chunks(require_carry=True) == 3
+    os.remove(mgr.carry_path(1))
+    assert mgr.completed_chunks(require_carry=True) == 1
+    assert mgr.completed_chunks() == 3  # plain chunk scan unaffected
+
+    resumed, info = solve_scan_l1_checkpointed(
+        qp, 6, w_init, 0.01, d, params=params, segment_size=2,
+        universes=FIXED_UNIVERSE)
+    assert info["resumed_segments"] == 1  # rolled back to the carry
+    np.testing.assert_array_equal(np.asarray(golden.x),
+                                  np.asarray(resumed.x))
+
+
+def test_run_batch_checkpointed_crash_seam_identity(tmp_path):
+    """backtest.chunk seam in run_batch_checkpointed: an injected
+    crash after chunk 0 leaves exactly the chunks-so-far on disk, and
+    CheckpointManager reports them resumable."""
+    from porqua_tpu.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager.create(str(tmp_path / "r"),
+                                   [f"d{i}" for i in range(4)], 2,
+                                   PARAMS)
+    # The seam contract, minus the heavyweight BacktestService: fire
+    # the seam exactly as run_batch_checkpointed does after each save.
+    crash = faults.Scenario("crash", (faults.FaultSpec.make(
+        "backtest.chunk", "crash", start=1, count=1),))
+    with faults.active(crash):
+        assert faults.fire("backtest.chunk", idx=0) is None
+        with pytest.raises(faults.InjectedCrash):
+            faults.fire("backtest.chunk", idx=1)
+
+
+# ---------------------------------------------------------------------------
+# GC007 / GC104: the guard lint and the jaxpr-identity contract
+# ---------------------------------------------------------------------------
+
+def test_gc007_unguarded_seam_detected(tmp_path):
+    from porqua_tpu.analysis.lint import scan_paths
+
+    path = tmp_path / "serve" / "bad.py"
+    path.parent.mkdir(parents=True)
+    path.write_text(textwrap.dedent("""\
+        from porqua_tpu.resilience import faults as _faults
+
+        def dispatch(batch):
+            _faults.fire("serve.dispatch")          # unguarded: flagged
+            if _faults.enabled():
+                _faults.fire("serve.dispatch")      # guarded: clean
+            return batch
+        """))
+    hits = [(f.rule, f.line) for f in scan_paths([str(path)],
+                                                 rules={"GC007"})]
+    assert hits == [("GC007", 4)]
+
+
+def test_gc007_bare_import_forms(tmp_path):
+    from porqua_tpu.analysis.lint import scan_paths
+
+    path = tmp_path / "serve" / "bad2.py"
+    path.parent.mkdir(parents=True)
+    path.write_text(textwrap.dedent("""\
+        from porqua_tpu.resilience.faults import enabled, fire
+
+        def dispatch(batch):
+            fire("serve.dispatch")
+            if enabled():
+                fire("serve.dispatch")
+            return batch
+        """))
+    hits = [(f.rule, f.line) for f in scan_paths([str(path)],
+                                                 rules={"GC007"})]
+    assert hits == [("GC007", 4)]
+
+
+def test_gc104_identity_contract_shipped_tree():
+    """With a live injector installed over EVERY seam, the traced
+    solve/serve programs must be string-identical to the bare traces —
+    the machine-checked 'bit-identical when disabled' promise."""
+    from porqua_tpu.analysis.contracts import check_resilience_identity
+
+    assert check_resilience_identity() == []
