@@ -8,12 +8,21 @@ Four enforcement layers (see each submodule's docstring):
   parent ``porqua_tpu`` package import still runs first.
 * :mod:`porqua_tpu.analysis.guards` — GC006, the ``# guarded-by:``
   thread-safety lint for the serving stack.
+* :mod:`porqua_tpu.analysis.concurrency` — GC008-GC010, the
+  concurrency plane: inferred lock discipline over a thread-root
+  reachability graph, static lock-order deadlock detection, and the
+  blocking-call-under-lock lint.
 * :mod:`porqua_tpu.analysis.contracts` — GC101-GC103, trace-time jaxpr
   contracts on the public batch entry points (imports JAX; loaded
   lazily so the lint path stays light).
 * :mod:`porqua_tpu.analysis.sanitize` — the ``PORQUA_SANITIZE=1``
   runtime mode: ``jax.transfer_guard`` around solver dispatches and a
   hard zero-recompiles-after-warmup assertion in serving.
+* :mod:`porqua_tpu.analysis.tsan` — the ``PORQUA_TSAN=1`` runtime
+  lock-order sanitizer: instrumented locks maintaining per-thread
+  held-lock sets and the process-wide acquisition-order graph,
+  raising ``SanitizerError`` on order inversions, hold-time budget
+  breaches, and live wait-for deadlocks.
 
 CLI: ``python scripts/run_checks.py porqua_tpu/`` (wired into
 ``scripts/run_tests.sh``). Suppressions: ``# graftcheck:
@@ -25,16 +34,22 @@ from porqua_tpu.analysis.lint import (  # noqa: F401
     Finding,
     RULE_DOCS,
     scan_paths,
+    suppression_stats,
 )
 from porqua_tpu.analysis.guards import check_guarded_by  # noqa: F401
+from porqua_tpu.analysis.concurrency import check_concurrency  # noqa: F401
 from porqua_tpu.analysis import sanitize  # noqa: F401
+from porqua_tpu.analysis import tsan  # noqa: F401
 
 __all__ = [
     "Finding",
     "RULE_DOCS",
     "scan_paths",
+    "suppression_stats",
     "check_guarded_by",
+    "check_concurrency",
     "sanitize",
+    "tsan",
     "contracts",
 ]
 

@@ -32,6 +32,16 @@ declarative and machine-checked:
 A ``with`` held-lock context does NOT propagate into nested ``def``s:
 a nested function is typically a thread target or callback that runs
 without the lock.
+
+Scope: GC006 is **opt-in** — it enforces exactly the attributes
+someone annotated. Its inference-side complement is GC008
+(:mod:`porqua_tpu.analysis.concurrency`), which walks the thread-root
+reachability graph and flags *unannotated* ``self`` attributes
+mutated from two or more roots with no lock held; an attribute GC008
+surfaces is fixed by adding the ``# guarded-by:`` annotation (plus
+the lock, where the mutation was a true race), which moves it into
+this rule's jurisdiction. The writer-side rules here and the
+mutation detection in GC008 share one ``_MUTATORS`` vocabulary.
 """
 
 from __future__ import annotations
@@ -47,10 +57,16 @@ __all__ = ["check_guarded_by"]
 _GUARD_RE = re.compile(r"#\s*guarded-by:\s*self\.(\w+)")
 
 #: method names whose call on a guarded attribute mutates it
+#: (shared with GC008's mutation detection). ``__setitem__`` /
+#: ``__delitem__`` cover the explicit dunder-call spelling of a
+#: subscript/slice store (``self._data.__setitem__(slice(0, k), v)``)
+#: — the operator forms are caught as Subscript targets; ``rotate``
+#: is deque's in-place rotation.
 _MUTATORS = {
     "append", "appendleft", "extend", "insert", "add", "discard",
     "remove", "pop", "popitem", "popleft", "clear", "update",
-    "setdefault", "move_to_end", "sort", "reverse",
+    "setdefault", "move_to_end", "sort", "reverse", "rotate",
+    "__setitem__", "__delitem__",
 }
 
 _CTOR_EXEMPT = {"__init__", "__post_init__", "__new__", "__del__"}

@@ -52,11 +52,14 @@ GC007   Fault-injection seam (``porqua_tpu.resilience.faults.fire``)
         GC104 jaxpr-identity contract).
 
 GC006 (the ``# guarded-by:`` thread-safety lint) lives in
-:mod:`porqua_tpu.analysis.guards`; GC101-GC104 (trace-time jaxpr
-contracts) live in :mod:`porqua_tpu.analysis.contracts`. This module's
-own code is pure stdlib ``ast`` — it adds no JAX work of its own,
-though reaching it through the package path still executes
-``porqua_tpu/__init__`` (which imports the solver stack).
+:mod:`porqua_tpu.analysis.guards`; GC008-GC010 (the concurrency plane:
+inferred lock discipline, static deadlock detection, blocking-call-
+under-lock) live in :mod:`porqua_tpu.analysis.concurrency`;
+GC101-GC104 (trace-time jaxpr contracts) live in
+:mod:`porqua_tpu.analysis.contracts`. This module's own code is pure
+stdlib ``ast`` — it adds no JAX work of its own, though reaching it
+through the package path still executes ``porqua_tpu/__init__``
+(which imports the solver stack).
 """
 
 from __future__ import annotations
@@ -74,6 +77,7 @@ __all__ = [
     "iter_py_files",
     "load_module",
     "scan_paths",
+    "suppression_stats",
 ]
 
 RULE_DOCS = {
@@ -84,6 +88,9 @@ RULE_DOCS = {
     "GC005": "backend-initializing work at module import time",
     "GC006": "guarded-by attribute mutated without its lock",
     "GC007": "fault seam not guarded by the injector-enabled check",
+    "GC008": "unannotated shared state mutated from multiple thread roots",
+    "GC009": "lock-order cycle (potential deadlock)",
+    "GC010": "blocking call while holding a lock",
     "GC101": "float64 leaked into a traced program",
     "GC102": "callback/transfer primitive inside a traced program",
     "GC103": "unstable output dtype in a traced program",
@@ -892,10 +899,34 @@ def load_module(path: str) -> ModuleInfo:
         return ModuleInfo(path, fh.read())
 
 
+def suppression_stats(mods: Sequence[ModuleInfo]) -> Dict[str, int]:
+    """Per-rule suppression-directive counts across ``mods`` (each
+    file-level directive counts 1 per rule, each line directive 1 per
+    (line, rule)). The CLI's ``--stats`` surfaces these so suppression
+    creep is visible in CI output — the shipped tree's bar is zero.
+    Only recognized rule ids (and ``all``) are counted: a directive
+    naming a rule that does not exist suppresses nothing real (doc
+    examples spelling ``GC00x`` would otherwise read as creep)."""
+    known = set(RULE_DOCS) | {"all"}
+    out: Dict[str, int] = {}
+    for mod in mods:
+        for rule in mod.file_suppress:
+            if rule in known:
+                out[rule] = out.get(rule, 0) + 1
+        for rules in mod.line_suppress.values():
+            for rule in rules:
+                if rule in known:
+                    out[rule] = out.get(rule, 0) + 1
+    return out
+
+
 def scan_paths(paths: Sequence[str],
-               rules: Optional[Set[str]] = None) -> List[Finding]:
-    """Run every AST rule (GC001-GC006) over ``paths`` (files or
-    directory trees). ``rules`` restricts to a subset of rule ids."""
+               rules: Optional[Set[str]] = None,
+               stats_out: Optional[dict] = None) -> List[Finding]:
+    """Run every AST rule (GC001-GC010) over ``paths`` (files or
+    directory trees). ``rules`` restricts to a subset of rule ids.
+    ``stats_out``, when given, is populated with per-rule finding and
+    suppression counts plus the scanned-file count."""
     mods: List[ModuleInfo] = []
     findings: List[Finding] = []
     for path in iter_py_files(paths):
@@ -932,5 +963,15 @@ def scan_paths(paths: Sequence[str],
         from porqua_tpu.analysis.guards import check_guarded_by
         for mod in mods:
             findings.extend(check_guarded_by(mod))
+    if want("GC008") or want("GC009") or want("GC010"):
+        from porqua_tpu.analysis.concurrency import check_concurrency
+        findings.extend(check_concurrency(mods, rules=rules))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    if stats_out is not None:
+        by_rule: Dict[str, int] = {}
+        for f in findings:
+            by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+        stats_out["files"] = len(mods)
+        stats_out["findings_by_rule"] = by_rule
+        stats_out["suppressions_by_rule"] = suppression_stats(mods)
     return findings

@@ -45,7 +45,6 @@ active-count readout is an explicit ``jax.device_get``.
 from __future__ import annotations
 
 import dataclasses
-import threading
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -53,7 +52,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from porqua_tpu.analysis import sanitize
+from porqua_tpu.analysis import sanitize, tsan
 from porqua_tpu.qp.admm import Status
 from porqua_tpu.qp.canonical import CanonicalQP
 from porqua_tpu.qp.solve import (
@@ -194,7 +193,7 @@ class CompactingDriver:
         # keeps the batched lowering and measured bit-exactness.
         self.min_dispatch = max(1, int(min_dispatch))
         self.device = device
-        self._lock = threading.Lock()
+        self._lock = tsan.lock("CompactingDriver")
         self._cache: dict = {}          # guarded-by: self._lock
         self.compiles = 0               # guarded-by: self._lock
         self._sealed = False            # guarded-by: self._lock

@@ -18,9 +18,10 @@ from __future__ import annotations
 
 import collections
 import json
-import threading
 import time
 from typing import Any, Dict, List, Optional
+
+from porqua_tpu.analysis import tsan
 
 #: Severity order, least to most severe.
 SEVERITIES = ("debug", "info", "warn", "error")
@@ -42,7 +43,7 @@ class EventBus:
     def __init__(self, capacity: int = 65536,
                  path: Optional[str] = None) -> None:
         self.capacity = int(capacity)
-        self._lock = threading.Lock()
+        self._lock = tsan.lock("EventBus")
         # guarded-by: self._lock
         self._events: "collections.deque[Dict[str, Any]]" = (
             collections.deque(maxlen=self.capacity))

@@ -25,9 +25,10 @@ import dataclasses
 import itertools
 import json
 import os
-import threading
 import time
 from typing import Any, Dict, List, Optional
+
+from porqua_tpu.analysis import tsan
 
 
 @dataclasses.dataclass(frozen=True)
@@ -63,7 +64,7 @@ class SpanRecorder:
 
     def __init__(self, capacity: int = 262144) -> None:
         self.capacity = int(capacity)
-        self._lock = threading.Lock()
+        self._lock = tsan.lock("SpanRecorder")
         self._spans: List[Span] = []      # guarded-by: self._lock
         self._dropped = 0                 # guarded-by: self._lock
         self._ids = itertools.count()

@@ -52,6 +52,7 @@ from typing import Any, Callable, Dict, Optional
 
 import numpy as np
 
+from porqua_tpu.analysis import tsan
 from porqua_tpu.serve.batcher import DeadlineExpired, SolveError
 
 __all__ = ["RetryPolicy", "RetryManager", "validate_result"]
@@ -142,7 +143,7 @@ class RetryManager:
         self.events = events
         self.clock = time.monotonic if clock is None else clock
         self._rng = np.random.default_rng(policy.seed)
-        self._lock = threading.Lock()
+        self._lock = tsan.lock("RetryManager")
         # guarded-by: self._lock
         self._entries: "OrderedDict[str, _Entry]" = OrderedDict()
         self._timers: list = []         # guarded-by: self._lock (heap)

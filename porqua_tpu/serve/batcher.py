@@ -33,7 +33,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from porqua_tpu.analysis import sanitize
+from porqua_tpu.analysis import sanitize, tsan
 from porqua_tpu.qp.admm import Status
 from porqua_tpu.qp.canonical import CanonicalQP, stack_qps
 from porqua_tpu.resilience import faults as _faults
@@ -134,7 +134,7 @@ class WarmStartCache:
 
     def __init__(self, capacity: int = 4096) -> None:
         self.capacity = int(capacity)
-        self._lock = threading.Lock()
+        self._lock = tsan.lock("WarmStartCache")
         # guarded-by: self._lock
         self._data: "collections.OrderedDict[tuple, Tuple[np.ndarray, np.ndarray]]" = (
             collections.OrderedDict())

@@ -28,7 +28,7 @@ from typing import Dict, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
-from porqua_tpu.analysis import sanitize
+from porqua_tpu.analysis import sanitize, tsan
 from porqua_tpu.qp.canonical import CanonicalQP, pad_qp
 from porqua_tpu.resilience import faults as _faults
 from porqua_tpu.qp.solve import (
@@ -124,9 +124,12 @@ class ExecutableCache:
     ``SolverParams`` is fixed per cache (it is part of the service
     identity, not the request); the device is part of the key so the
     circuit breaker's fallback device gets its own executables instead
-    of a cross-device crash. Thread-safe; compiles happen under the
-    lock on purpose — two threads racing the same miss would otherwise
-    both pay the compile.
+    of a cross-device crash. Thread-safe; compiles happen OUTSIDE the
+    lock — a multi-second AOT compile under the cache lock would wedge
+    every other bucket's cache hit behind one cold shape (graftcheck
+    GC010). Two threads racing the same miss still compile once: the
+    first claims the key with a pending marker and builds, the rest
+    wait on the marker and re-read the cache.
     """
 
     def __init__(self, params: SolverParams = SolverParams(),
@@ -137,8 +140,11 @@ class ExecutableCache:
         # structured event (post-warmup ones at "warn" — they are the
         # steady-state-recompile regression the counters gate on).
         self.events = events
-        self._lock = threading.Lock()
+        self._lock = tsan.lock("ExecutableCache")
         self._cache: Dict[tuple, object] = {}  # guarded-by: self._lock
+        # key -> threading.Event while a compile for it is in flight
+        # (set + removed by the builder; waiters re-read the cache)
+        self._inflight: Dict[tuple, threading.Event] = {}  # guarded-by: self._lock
         # Sanitizer warmup state, scoped per cache AND per device: a
         # device whose ladder prewarmed is sealed — misses on it are
         # steady-state recompiles (raise under PORQUA_SANITIZE=1) —
@@ -180,33 +186,63 @@ class ExecutableCache:
              kind: str = "solve"):
         """(executable, missed): ``missed`` lets prewarm count ITS OWN
         compiles exactly instead of diffing cache sizes across threads."""
-        key = (kind, bucket, int(slots), np.dtype(dtype).str,
-               self._device_key(device))
-        with self._lock:
-            if _faults.enabled():
-                # cache.get seam: a compile_storm directive evicts this
-                # entry, so a post-warmup dispatch pays a fresh AOT
-                # compile — the induced form of the steady-state-
-                # recompile regression the compile counters/events (and
-                # PORQUA_SANITIZE) exist to surface.
-                act = _faults.fire("cache.get", cache_kind=kind,
-                                   slots=int(slots))
-                if act is not None and act.kind == "compile_storm":
+        dev_key = self._device_key(device)
+        key = (kind, bucket, int(slots), np.dtype(dtype).str, dev_key)
+        if _faults.enabled():
+            # cache.get seam: a compile_storm directive evicts this
+            # entry, so a post-warmup dispatch pays a fresh AOT
+            # compile — the induced form of the steady-state-
+            # recompile regression the compile counters/events (and
+            # PORQUA_SANITIZE) exist to surface. Fired outside the
+            # cache lock (the injector takes its own).
+            act = _faults.fire("cache.get", cache_kind=kind,
+                               slots=int(slots))
+            if act is not None and act.kind == "compile_storm":
+                with self._lock:
                     self._cache.pop(key, None)
-            exe = self._cache.get(key)
-            if exe is not None:
+        while True:
+            wait_for = None
+            with self._lock:
+                exe = self._cache.get(key)
+                if exe is not None:
+                    hit = True
+                else:
+                    hit = False
+                    wait_for = self._inflight.get(key)
+                    if wait_for is None:
+                        # Claim the key: this thread builds; the
+                        # warmup decision snapshots atomically with
+                        # the claim.
+                        self._inflight[key] = threading.Event()
+                        post_warmup = (
+                            dev_key in self._warmed_devices
+                            and not self._warming.get((bucket, dev_key)))
+            if hit:
                 if self.metrics is not None:
                     self.metrics.inc("cache_hits")
                 return exe, False
-            t0 = time.perf_counter()
+            if wait_for is not None:
+                # Another thread is compiling this exact key: wait for
+                # it (NOT under the lock — other buckets keep hitting)
+                # and re-read; if the builder failed, the loop retries
+                # and this thread becomes the builder.
+                wait_for.wait()
+                continue
+            return self._build(key, bucket, slots, dtype, device,
+                               kind, dev_key, post_warmup), True
+
+    def _build(self, key, bucket: Bucket, slots: int, dtype, device,
+               kind: str, dev_key, post_warmup: bool):
+        """Compile one claimed cache entry OUTSIDE the cache lock (a
+        multi-second AOT compile must not block unrelated hits), then
+        publish it and release the waiters."""
+        t0 = time.perf_counter()
+        try:
             # Sanitizer hook: every AOT compile is counted; after
             # prewarm() closes this cache's warmup window, a miss here
             # raises under PORQUA_SANITIZE=1 (the zero-steady-state-
             # recompiles invariant) instead of silently paying a
             # multi-second compile mid-traffic.
-            dev_key = self._device_key(device)
-            post_warmup = (dev_key in self._warmed_devices
-                           and not self._warming.get((bucket, dev_key)))
             try:
                 sanitize.note_compile(
                     f"kind={kind} bucket={bucket} slots={int(slots)} "
@@ -229,18 +265,27 @@ class ExecutableCache:
                                              device=device)
             else:
                 exe = aot_compile_batch(struct, self.params, device=device)
-            self._cache[key] = exe
-            seconds = time.perf_counter() - t0
-            if self.metrics is not None:
-                self.metrics.observe_compile(seconds)
-            if self.events is not None:
-                self.events.emit(
-                    "compile", "warn" if post_warmup else "info",
-                    bucket=f"{bucket.n}x{bucket.m}",
-                    factor_rows=bucket.factor_rows, slots=int(slots),
-                    device=str(dev_key), seconds=round(seconds, 4),
-                    post_warmup=post_warmup)
-            return exe, True
+            with self._lock:
+                self._cache[key] = exe
+        finally:
+            # Success or failure, drop the claim and wake the waiters
+            # (on failure they re-race the miss; one re-raises the
+            # same refusal rather than hanging on an orphaned event).
+            with self._lock:
+                pending = self._inflight.pop(key, None)
+            if pending is not None:
+                pending.set()
+        seconds = time.perf_counter() - t0
+        if self.metrics is not None:
+            self.metrics.observe_compile(seconds)
+        if self.events is not None:
+            self.events.emit(
+                "compile", "warn" if post_warmup else "info",
+                bucket=f"{bucket.n}x{bucket.m}",
+                factor_rows=bucket.factor_rows, slots=int(slots),
+                device=str(dev_key), seconds=round(seconds, 4),
+                post_warmup=post_warmup)
+        return exe
 
     @property
     def warmed(self) -> bool:

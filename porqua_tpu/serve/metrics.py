@@ -20,11 +20,12 @@ snapshot readers on whichever thread polls.
 from __future__ import annotations
 
 import json
-import threading
 import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
+
+from porqua_tpu.analysis import tsan
 
 
 #: Counter names, so consumers can rely on every key existing (a
@@ -80,7 +81,7 @@ class ServeMetrics:
     """Counters + reservoirs for the online solve service."""
 
     def __init__(self, latency_reservoir: int = 65536) -> None:
-        self._lock = threading.Lock()
+        self._lock = tsan.lock("ServeMetrics")
         self._reservoir_cap = int(latency_reservoir)
         self.reset_window()
 

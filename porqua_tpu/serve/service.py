@@ -29,6 +29,7 @@ from typing import NamedTuple, Optional
 import jax
 import numpy as np
 
+from porqua_tpu.analysis import tsan
 from porqua_tpu.qp.canonical import CanonicalQP
 from porqua_tpu.qp.solve import SolverParams
 from porqua_tpu.resilience import faults as _faults
@@ -98,7 +99,7 @@ class DeviceHealth:
         # a stepped porqua_tpu.resilience.FaultClock instead of
         # waiting out wall-clock recovery intervals.
         self.clock = time.monotonic if clock is None else clock
-        self._lock = threading.Lock()
+        self._lock = tsan.lock("DeviceHealth")
         self._failures = 0            # guarded-by: self._lock
         self._degraded = False        # guarded-by: self._lock
         self._opened_at = 0.0         # guarded-by: self._lock
@@ -180,13 +181,17 @@ class DeviceHealth:
     def startup_check(self) -> None:
         """Probe the primary before accepting traffic; a dead primary
         trips the breaker immediately (requests never see the failure,
-        they just start on the fallback)."""
+        they just start on the fallback). The probes run OUTSIDE the
+        lock — each can block for ``probe_timeout_s`` against a
+        black-holing device, and pinning the health lock for that
+        window would freeze ``device()``/``record_*`` on every other
+        thread for the whole startup (graftcheck GC010)."""
         if self.primary is self.fallback:
             return
+        for _ in range(self.failure_threshold):
+            if self._probe_with_timeout(self.primary):
+                return
         with self._lock:
-            for _ in range(self.failure_threshold):
-                if self._probe_with_timeout(self.primary):
-                    return
             self._trip()
 
     def device(self):

@@ -10,8 +10,12 @@ driver ``porqua_tpu/compaction.py``, the continuous batcher
 ``porqua_tpu/serve/continuous.py``, and the resilience plane
 ``porqua_tpu/resilience/`` (all of which must scan clean with zero
 suppressions, same bar as the solver) — with every AST rule
-(GC001-GC007; GC007 enforces the ``if faults.enabled():`` guard on
-every fault-injection seam) plus the trace-time jaxpr contracts
+(GC001-GC010; GC007 enforces the ``if faults.enabled():`` guard on
+every fault-injection seam; GC008-GC010 are the concurrency plane —
+shared state inferred from the thread-root reachability graph, static
+lock-order deadlock detection, and blocking-calls-under-a-lock — whose
+runtime half is the ``PORQUA_TSAN=1`` lock-order sanitizer exercised
+by ``scripts/tsan_smoke.py``) plus the trace-time jaxpr contracts
 (GC101-GC104) against the real batch entry points on the XLA-CPU
 backend: default solver params, the convergence-ring telemetry
 variant (``SolverParams(ring_size>0)``), the compaction
@@ -29,6 +33,10 @@ Options:
     --no-contracts         skip the jaxpr contract checks (used when
                            scanning fixture trees that are not the
                            real package)
+    --stats                emit per-rule finding AND suppression
+                           counts (JSON: a "stats" object in the
+                           payload; text: a summary block) so
+                           suppression creep is visible in CI output
 
 Wired into scripts/run_tests.sh so the gate runs everywhere tests do.
 Suppressions: ``# graftcheck: disable=GC00x`` (line),
@@ -62,6 +70,8 @@ def main(argv=None) -> int:
                         help="comma-separated rule ids to run")
     parser.add_argument("--no-contracts", action="store_true",
                         help="skip the jaxpr entry-point contracts")
+    parser.add_argument("--stats", action="store_true",
+                        help="emit per-rule finding/suppression counts")
     args = parser.parse_args(argv)
 
     paths = args.paths or [os.path.join(_REPO_ROOT, "porqua_tpu")]
@@ -82,7 +92,9 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
 
-    findings = scan_paths(paths, rules=rules)
+    stats: dict = {}
+    findings = scan_paths(paths, rules=rules,
+                          stats_out=stats if args.stats else None)
 
     if not args.no_contracts and (
             rules is None or rules & {"GC101", "GC102", "GC103", "GC104"}):
@@ -114,15 +126,38 @@ def main(argv=None) -> int:
         findings = [f for f in findings
                     if f.rule in rules or f.rule == "GC000"]
 
+    if args.stats:
+        # Contract findings land after the AST scan: recount per rule
+        # over the final (selected) finding list so the stats describe
+        # exactly what is reported.
+        by_rule: dict = {}
+        for f in findings:
+            by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+        stats["findings_by_rule"] = by_rule
+        stats["suppressions_total"] = sum(
+            stats.get("suppressions_by_rule", {}).values())
+
     if args.format == "json":
-        print(json.dumps({
+        payload = {
             "findings": [f.to_dict() for f in findings],
             "count": len(findings),
             "rules": RULE_DOCS,
-        }, indent=2))
+        }
+        if args.stats:
+            payload["stats"] = stats
+        print(json.dumps(payload, indent=2))
     else:
         for f in findings:
             print(f.format())
+        if args.stats:
+            print("rule      findings  suppressions")
+            names = sorted(set(stats["findings_by_rule"])
+                           | set(stats["suppressions_by_rule"]))
+            for rule in names:
+                print(f"{rule:<9} {stats['findings_by_rule'].get(rule, 0):>8}"
+                      f"  {stats['suppressions_by_rule'].get(rule, 0):>12}")
+            print(f"files scanned: {stats['files']}; suppressions "
+                  f"total: {stats['suppressions_total']}")
         n = len(findings)
         print(f"graftcheck: {n} finding{'s' if n != 1 else ''}"
               + ("" if n else " — clean"))

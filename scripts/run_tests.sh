@@ -12,11 +12,26 @@ fail=0
 # the tests do (rule docs: README "Static analysis & sanitizers"). The
 # porqua_tpu scan set includes porqua_tpu/obs (zero suppressions), and
 # the jaxpr contracts trace the telemetry-enabled (ring_size>0) batch
-# entry points alongside the defaults.
-if out=$(timeout 600 python scripts/run_checks.py porqua_tpu 2>&1); then
+# entry points alongside the defaults. --stats keeps the per-rule
+# finding/suppression counts in CI output (suppression creep is a
+# reviewable number, bar: 0).
+if out=$(timeout 600 python scripts/run_checks.py porqua_tpu --stats 2>&1); then
     echo "OK   graftcheck: $(echo "$out" | tail -1)"
 else
     echo "FAIL graftcheck:"
+    echo "$out"
+    fail=1
+fi
+
+# TSAN loadgen smoke: the PORQUA_TSAN=1 lock-order sanitizer under a
+# real closed-loop load pass (retry + hedging on, so caller threads,
+# the dispatch loop, the timer wheel, and future callbacks all contend
+# on the instrumented locks). Static GC008-GC010 prove the discipline
+# on source; this proves it on the live interleaving.
+if out=$(timeout 600 python scripts/tsan_smoke.py 2>&1); then
+    echo "OK   tsan_smoke: $(echo "$out" | tail -1)"
+else
+    echo "FAIL tsan_smoke:"
     echo "$out"
     fail=1
 fi
@@ -35,7 +50,10 @@ fi
 # (classic + continuous) with the recovery invariants asserted — any
 # invariant violation exits nonzero (README "Resilience & chaos
 # testing"; the full degradation matrix: scripts/chaos_suite.py).
-if out=$(timeout 600 python scripts/chaos_suite.py --selftest 2>&1); then
+# PORQUA_TSAN=1: breaker trips/recovery nest the health lock over the
+# metrics/event locks, so the chaos pass doubles as the lock-order
+# sanitizer's stress case on the recovery paths.
+if out=$(timeout 600 env PORQUA_TSAN=1 python scripts/chaos_suite.py --selftest 2>&1); then
     echo "OK   chaos_suite --selftest: $(echo "$out" | tail -1)"
 else
     echo "FAIL chaos_suite --selftest:"

@@ -291,6 +291,34 @@ def test_gc006_guarded_by(tmp_path):
     assert hits == [("GC006", 18), ("GC006", 21)]
 
 
+def test_gc006_setitem_slice_and_rotate_mutators(tmp_path):
+    # `__setitem__` spelled as a call (the slice-store idiom the
+    # subscript-target check can't see) and deque.rotate are
+    # mutations; both must require the lock.
+    hits = findings_for(tmp_path, "serve/locks.py", """\
+        import collections
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._buf = [0] * 8  # guarded-by: self._lock
+                self._dq = collections.deque()  # guarded-by: self._lock
+
+            def bad_slice_call(self, v):
+                self._buf.__setitem__(slice(0, 2), v)
+
+            def bad_rotate(self):
+                self._dq.rotate(1)
+
+            def good(self, v):
+                with self._lock:
+                    self._buf.__setitem__(slice(0, 2), v)
+                    self._dq.rotate(1)
+        """)
+    assert hits == [("GC006", 11), ("GC006", 14)]
+
+
 def test_gc006_nested_def_does_not_inherit_lock(tmp_path):
     hits = findings_for(tmp_path, "serve/locks.py", """\
         import threading
