@@ -881,8 +881,12 @@ def _secondary_config_serving(child_left, n_requests=1024, n_assets=24,
     # spans and write the Perfetto-loadable Chrome trace next to the
     # JSON artifact; span coverage figures join the payload.
     trace_out = os.environ.get("PORQUA_BENCH_TRACE_OUT") or None
+    # --harvest-out: append one telemetry-warehouse SolveRecord per
+    # resolved request (scripts/harvest_report.py aggregates).
+    harvest_out = os.environ.get("PORQUA_BENCH_HARVEST_OUT") or None
     report = run_loadgen(requests, max_batch=max_batch,
-                         inflight=4 * max_batch, trace_out=trace_out)
+                         inflight=4 * max_batch, trace_out=trace_out,
+                         harvest_out=harvest_out)
     _emit({
         "part": "config_serving",
         "n_requests": n_requests,
@@ -903,6 +907,13 @@ def _secondary_config_serving(child_left, n_requests=1024, n_assets=24,
         **({"trace_out": report.get("trace_out"),
             "span_cover_median": report.get("span_cover_median")}
            if trace_out else {}),
+        **({"harvest_out": report.get("harvest_out"),
+            "harvest_records": report.get("harvest_records"),
+            "harvest_records_measured":
+                report.get("harvest_records_measured"),
+            "harvest_write_failures":
+                report.get("harvest_write_failures")}
+           if harvest_out else {}),
         "note": "closed-loop serve_loadgen stream through "
                 "porqua_tpu.serve.SolveService (dynamic micro-batching "
                 "+ AOT executable cache); recompiles_after_warmup==0 "
@@ -1238,6 +1249,15 @@ def _assemble(state) -> dict:
             "device_solved": result["solved"],
             "compile_seconds": round(result["compile_s"], 2),
         })
+        # The iteration distribution + wasted-work accounting (emitted
+        # by the child since round 5) belongs in the top-level artifact
+        # too: scripts/bench_gate.py gates iters_p95 /
+        # wasted_iteration_fraction across rounds, and a field the
+        # artifact drops is a field the gate can never protect.
+        for key in ("iters_p50", "iters_p95", "iters_max",
+                    "wasted_iteration_fraction", "status_counts"):
+            if result.get(key) is not None:
+                payload[key] = result[key]
         # Which solver config produced the number (platform-conditional
         # since round 3: TPU runs the capacitance/woodbury segments).
         for key in ("linsolve", "check_interval"):
@@ -1302,20 +1322,28 @@ def _assemble(state) -> dict:
     return payload
 
 
+def _consume_path_flag(flag: str, env_var: str) -> None:
+    """Pop ``<flag> PATH`` from argv into ``env_var`` (absolute).
+    Threaded via the environment because the serving config runs
+    inside the device child (spawned with the parent's env) — the
+    flag works on the parent invocation and on a directly-run child
+    alike."""
+    if flag not in sys.argv:
+        return
+    i = sys.argv.index(flag)
+    if i + 1 >= len(sys.argv):
+        print(f"bench.py: {flag} requires a path", file=sys.stderr)
+        sys.exit(2)
+    os.environ[env_var] = os.path.abspath(sys.argv[i + 1])
+    del sys.argv[i:i + 2]
+
+
 def main():
-    # --trace-out PATH: have the serving config record request spans
-    # and write a Perfetto-loadable Chrome trace there. Threaded via
-    # the environment because the serving config runs inside the
-    # device child (spawned with the parent's env) — the flag works on
-    # the parent invocation and on a directly-run child alike.
-    if "--trace-out" in sys.argv:
-        i = sys.argv.index("--trace-out")
-        if i + 1 >= len(sys.argv):
-            print("bench.py: --trace-out requires a path", file=sys.stderr)
-            sys.exit(2)
-        os.environ["PORQUA_BENCH_TRACE_OUT"] = os.path.abspath(
-            sys.argv[i + 1])
-        del sys.argv[i:i + 2]
+    # --trace-out PATH: the serving config records request spans and
+    # writes a Perfetto-loadable Chrome trace there. --harvest-out
+    # PATH: it appends its telemetry-warehouse dataset there.
+    _consume_path_flag("--trace-out", "PORQUA_BENCH_TRACE_OUT")
+    _consume_path_flag("--harvest-out", "PORQUA_BENCH_HARVEST_OUT")
     if len(sys.argv) >= 3 and sys.argv[1] == "--device-child":
         device_child(sys.argv[2], int(sys.argv[3])
                      if len(sys.argv) > 3 else N_DATES)

@@ -44,6 +44,7 @@ __all__ = [
     "check_entry_points",
     "check_resilience_identity",
     "check_run_batch",
+    "check_telemetry_identity",
     "compaction_step_jaxpr",
     "continuous_jaxprs",
     "solve_batch_jaxpr",
@@ -314,6 +315,66 @@ def check_resilience_identity(dtype=np.float32) -> List[Finding]:
     return findings
 
 
+def check_telemetry_identity(dtype=np.float32) -> List[Finding]:
+    """GC105: the telemetry warehouse must be invisible to XLA.
+
+    The harvest/profiling plane (:mod:`porqua_tpu.obs.harvest`,
+    :mod:`porqua_tpu.obs.profile`) promises it is pure host
+    post-processing: records are built from arrays the producers
+    already fetched, and stage brackets wrap dispatches from the
+    OUTSIDE (``jax.profiler.TraceAnnotation`` — metadata, not
+    program). "Harvest disabled = bit-identical program" is the
+    acceptance bar; this check machine-verifies the enabled half:
+    the solve/serve/compaction-step entry points are traced once
+    bare, then again INSIDE a live :class:`StageProfiler` stage (its
+    trace annotation active) with a live :class:`HarvestSink` in
+    scope, and the jaxprs are required to be string-identical — no
+    new primitives, no callbacks, no dtype drift.
+    """
+    from porqua_tpu.obs.harvest import HarvestSink, solve_record
+    from porqua_tpu.obs.profile import StageProfiler
+    from porqua_tpu.qp.solve import SolverParams
+
+    ring_params = SolverParams(ring_size=4)
+
+    def trace_all():
+        return [
+            ("solve_batch", str(solve_batch_jaxpr(dtype=dtype))),
+            ("solve_batch[rings]", str(solve_batch_jaxpr(
+                params=ring_params, dtype=dtype))),
+            ("serve_entry", str(serve_entry_jaxpr(dtype=dtype))),
+            ("compaction_step", str(compaction_step_jaxpr(dtype=dtype))),
+        ]
+
+    findings: List[Finding] = []
+    baseline = trace_all()
+    profiler = StageProfiler()
+    sink = HarvestSink(path=None)
+    with profiler.stage("gc105-contract"):
+        telemetered = trace_all()
+    # The sink must also demonstrably be pure host code: emitting a
+    # record between traces cannot perturb the next trace.
+    sink.emit(solve_record("batch", 4, 2, 1, 10, 0.0, 0.0, 0.0))
+    post_emit = str(solve_batch_jaxpr(dtype=dtype))
+    for (label, base), (_, tele) in zip(baseline, telemetered):
+        if base != tele:
+            findings.append(Finding(
+                "GC105", f"<jaxpr:{label}>", 0, 0,
+                "traced program differs inside an active StageProfiler "
+                "stage: the telemetry plane is no longer invisible to "
+                "XLA (harvest-disabled bit-identity contract broken)"))
+    if post_emit != baseline[0][1]:
+        findings.append(Finding(
+            "GC105", "<jaxpr:solve_batch>", 0, 0,
+            "traced program differs after a HarvestSink.emit — the "
+            "sink leaked state into tracing"))
+    if sink.records != 1 or sink.write_failures:
+        findings.append(Finding(
+            "GC105", "<jaxpr:telemetry_identity>", 0, 0,
+            "in-memory HarvestSink did not record the probe emit"))
+    return findings
+
+
 def run_batch_jaxpr(bs, params=None, dtype=np.float32) -> ClosedJaxpr:
     """Trace ``run_batch``'s device core against a *real*
     ``BacktestService``: the host pass (``build_problems``) runs for
@@ -391,4 +452,9 @@ def check_entry_points(dtype=np.float32,
     # string-identical to the bare ones (no new primitives, no
     # callbacks, bit-identical when disabled).
     findings += check_resilience_identity(dtype=dtype)
+    # GC105: same identity bar for the telemetry warehouse — tracing
+    # inside a live StageProfiler stage with a HarvestSink in scope
+    # must produce string-identical programs (harvest/profiling is
+    # host post-processing, never traced work).
+    findings += check_telemetry_identity(dtype=dtype)
     return findings

@@ -95,6 +95,7 @@ RULE_DOCS = {
     "GC102": "callback/transfer primitive inside a traced program",
     "GC103": "unstable output dtype in a traced program",
     "GC104": "fault injection perturbs a traced program",
+    "GC105": "telemetry (harvest/profiling) perturbs a traced program",
 }
 
 _CONTRACTIONS = {"dot", "einsum", "matmul", "tensordot", "inner", "vdot"}

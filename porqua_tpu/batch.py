@@ -131,7 +131,8 @@ def build_problems(bs: BacktestService,
 
 
 def solve_batch(problems: BatchProblems,
-                params: SolverParams = SolverParams()) -> QPSolution:
+                params: SolverParams = SolverParams(),
+                harvest=None) -> QPSolution:
     """Pass 2, independent dates: one vmapped device solve.
 
     Under ``PORQUA_SANITIZE=1`` the dispatch runs inside
@@ -139,18 +140,38 @@ def solve_batch(problems: BatchProblems,
     device by :func:`build_problems` (``stack_qps``), so any implicit
     host transfer the solve path picks up is a discipline bug and
     raises instead of silently round-tripping.
+
+    ``harvest`` (a :class:`porqua_tpu.obs.HarvestSink`) appends one
+    telemetry-warehouse SolveRecord per date AFTER the dispatch —
+    pure host post-processing of the returned arrays (it forces
+    completion, so the recorded wall seconds are honest); ``None``
+    leaves the solve byte-for-byte untouched, including its async
+    return.
     """
+    import time as _time
+
+    t0 = _time.perf_counter()
     with sanitize.transfer_guard():
-        return solve_qp_batch(problems.qp, params,
-                              l1_weight=problems.l1_weight,
-                              l1_center=problems.l1_center)
+        sol = solve_qp_batch(problems.qp, params,
+                             l1_weight=problems.l1_weight,
+                             l1_center=problems.l1_center)
+    if harvest is not None:
+        from porqua_tpu.obs.harvest import device_label_of, harvest_solution
+
+        np.asarray(sol.status)  # force completion: honest wall seconds
+        wall = _time.perf_counter() - t0
+        harvest_solution(harvest, sol, params, "batch",
+                         wall_s=wall, solve_s=wall,
+                         device=device_label_of(sol))
+    return sol
 
 
 def solve_batch_compacted(problems: BatchProblems,
                           params: SolverParams = SolverParams(),
                           segment_budget: Optional[int] = None,
                           compact: bool = True,
-                          driver=None):
+                          driver=None,
+                          harvest=None):
     """Pass 2 with segment-level batch compaction: wall-clock tracks
     total useful work instead of the slowest lane.
 
@@ -169,14 +190,16 @@ def solve_batch_compacted(problems: BatchProblems,
     (a mismatch raises rather than silently solving at the driver's
     tolerance); ``segment_budget`` is forwarded per call either way.
     Sanitizer semantics match :func:`solve_batch` (the driver runs its
-    dispatch loop inside the transfer guard itself).
+    dispatch loop inside the transfer guard itself). ``harvest``
+    appends one SolveRecord per date with the compaction accounting
+    and stage profile attached (source ``batch.compacted``).
     """
     from porqua_tpu.compaction import solve_batch_compacted as _solve
 
     return _solve(problems.qp, params, segment_budget=segment_budget,
                   l1_weight=problems.l1_weight,
                   l1_center=problems.l1_center,
-                  compact=compact, driver=driver)
+                  compact=compact, driver=driver, harvest=harvest)
 
 
 # Sentinel for scan-coupled entry points: the caller attests that every
@@ -489,12 +512,14 @@ def assemble_backtest(problems: BatchProblems,
 
 def run_batch(bs: BacktestService,
               params: Optional[SolverParams] = None,
-              dtype=jnp.float32) -> Backtest:
+              dtype=jnp.float32,
+              harvest=None) -> Backtest:
     """End-to-end batched backtest with the serial engine's output type.
 
     Equivalent to ``Backtest.run(bs)`` (reference ``backtest.py:201-224``)
     for date-independent strategies, but every date solves concurrently
-    in one XLA program.
+    in one XLA program. ``harvest`` appends one telemetry-warehouse
+    record per rebalance date (see :func:`solve_batch`).
     """
     # Build the problems FIRST, then default to the strategy's OWN
     # resolved solver configuration, like the serial engine does.
@@ -509,5 +534,5 @@ def run_batch(bs: BacktestService,
         # key on the dtype actually being solved, not the strategy's
         # declaration.
         params = bs.optimization.solver_params(solve_dtype=dtype)
-    solution = solve_batch(problems, params)
+    solution = solve_batch(problems, params, harvest=harvest)
     return assemble_backtest(problems, solution)

@@ -24,6 +24,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import time
 from typing import List, Optional
 
 import jax.numpy as jnp
@@ -252,6 +253,7 @@ def solve_scan_l1_checkpointed(qp,
                                directory: str,
                                params: SolverParams = SolverParams(),
                                segment_size: int = 64,
+                               harvest=None,
                                *,
                                universes):
     """:func:`porqua_tpu.batch.solve_scan_l1` with crash-resume — the
@@ -276,6 +278,13 @@ def solve_scan_l1_checkpointed(qp,
     ``resumed_segments`` / ``total_segments`` / ``directory``.
     ``universes`` is the same non-optional positional-carry
     attestation as the underlying scan entry points.
+
+    ``harvest`` (a :class:`porqua_tpu.obs.HarvestSink`) appends one
+    telemetry-warehouse SolveRecord per date as each segment's
+    solutions land (source ``backtest.scan``; the scan carry IS the
+    warm start, recorded as provenance ``scan_carry``). Records are
+    emitted only for dates solved in THIS run — resumed chunks were
+    harvested by the run that solved them.
     """
     import jax
 
@@ -321,11 +330,30 @@ def solve_scan_l1_checkpointed(qp,
         lo = idx * mgr.chunk_size
         hi = min(lo + mgr.chunk_size, T)
         qp_seg = jax.tree.map(lambda a: a[lo:hi], qp)
+        t_seg0 = time.perf_counter()
         sol, (carry_w, carry_x, carry_y) = _scan_l1_core(
             qp_seg, carry_w, l1w, params,
             x_init=carry_x, y_init=carry_y, return_carry=True)
         mgr.save_chunk(idx, sol)
         mgr.save_carry(idx, {"w": carry_w, "x": carry_x, "y": carry_y})
+        if harvest is not None:
+            from porqua_tpu.obs.harvest import (
+                device_label_of, harvest_solution)
+
+            # save_chunk already forced the arrays to host, so the
+            # wall includes the solve + completion, not a dispatch.
+            # Date 0 of a fresh (non-resumed) run solves from the cold
+            # initial carry — its record must not land in the warm
+            # population the warm-vs-cold aggregation trains against.
+            mask = None
+            if lo == 0:
+                mask = [False] + [True] * (hi - lo - 1)
+            harvest_solution(
+                harvest, sol, params, "backtest.scan",
+                wall_s=time.perf_counter() - t_seg0,
+                device=device_label_of(sol),
+                warm=True, warm_src="scan_carry", warm_mask=mask,
+                date_offset=lo)
         if _faults.enabled():
             # backtest.chunk seam: the induced SIGKILL for the
             # bit-parity tests fires AFTER the boundary persisted —

@@ -45,6 +45,7 @@ active-count readout is an explicit ``jax.device_get``.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -53,6 +54,7 @@ import jax
 import jax.numpy as jnp
 
 from porqua_tpu.analysis import sanitize, tsan
+from porqua_tpu.obs import profile as _profile
 from porqua_tpu.qp.admm import Status
 from porqua_tpu.qp.canonical import CanonicalQP
 from porqua_tpu.qp.solve import (
@@ -153,6 +155,12 @@ class CompactionReport:
     #                                (0 once prewarmed — the recompile
     #                                contract)
     max_iter_lanes: int            # lanes graded MAX_ITER post-polish
+    # Per-solve stage/roofline profile (obs.profile.qp_solve_profile
+    # output + per-stage seconds). Attached to EVERY solve — the
+    # estimate is a few hundred host float ops against a multi-second
+    # device solve, and always-on keeps the A/B payloads and harvest
+    # records uniform. (Optional typing only for hand-built reports.)
+    profile: Optional[dict] = None
 
     @property
     def savings_vs_dense(self) -> float:
@@ -179,8 +187,16 @@ class CompactingDriver:
                  params: SolverParams = SolverParams(),
                  segment_budget: Optional[int] = None,
                  min_dispatch: int = 2,
-                 device=None) -> None:
+                 device=None,
+                 profiler=None) -> None:
         self.params = params
+        # Optional porqua_tpu.obs.StageProfiler: the init /
+        # segment_step(+repack) / finalize dispatches are bracketed
+        # with jax.profiler trace annotations either way (a no-op
+        # unless a device trace is being captured); a profiler
+        # additionally accumulates per-stage host seconds and each
+        # solve's report carries a roofline estimate.
+        self.profiler = profiler
         if segment_budget is not None and segment_budget < 1:
             raise ValueError("segment_budget must be >= 1")
         self.segment_budget = int(segment_budget
@@ -387,8 +403,20 @@ class CompactingDriver:
             extra += (l1_weight, l1_center)
 
         sizes: List[int] = []
+        # Stage seconds are host brackets around the dispatches; the
+        # step loop syncs at every boundary (the active-count fetch)
+        # and finalize is forced below, so the brackets cover
+        # dispatch + completion in practice. Each bracket also enters
+        # the matching jax.profiler annotation (porqua/<stage>) so a
+        # captured device trace lines up. The repack runs fused inside
+        # the step executable — segment_step's bracket covers both.
+        stage_s = {"init": 0.0, "segment_step": 0.0, "finalize": 0.0}
+        t_solve0 = time.perf_counter()
         with sanitize.transfer_guard():
-            out = self._exe_init(skey)(qp, *extra)
+            with _profile.profiled_stage(self.profiler, "init",
+                                         "init") as prof:
+                out = self._exe_init(skey)(qp, *extra)
+            stage_s["init"] += prof["seconds"]
             scaled, scaling, carry, l1w_s, l1c_s, idx, seg_left = out
             # Full-batch references for the finalize pass (the group
             # below gets compacted; these stay at B, in lane order).
@@ -398,12 +426,16 @@ class CompactingDriver:
             group = (scaled, scaling, carry, l1w_s, l1c_s, idx, seg_left)
             b = B
             while True:
-                buf, group, n_active = self._exe_step(skey, b)(buf, group)
-                sizes.append(b)
-                # The one host sync per segment boundary: an explicit
-                # scalar fetch (transfer-guard-legal) deciding the next
-                # dispatch shape.
-                n_act = int(jax.device_get(n_active))
+                with _profile.profiled_stage(self.profiler, "segment_step",
+                                             "segment_step") as prof:
+                    buf, group, n_active = self._exe_step(skey, b)(buf,
+                                                                   group)
+                    sizes.append(b)
+                    # The one host sync per segment boundary: an
+                    # explicit scalar fetch (transfer-guard-legal)
+                    # deciding the next dispatch shape.
+                    n_act = int(jax.device_get(n_active))
+                stage_s["segment_step"] += prof["seconds"]
                 if n_act == 0:
                     break
                 if compact:
@@ -415,10 +447,14 @@ class CompactingDriver:
                         b = b_next
             l1_args = ((l1_weight, l1_center, l1ws_full, l1cs_full)
                        if has_l1 else ())
-            sol = self._exe_finalize(skey)(qp, scaled_full, scaling_full,
-                                           buf, *l1_args)
+            with _profile.profiled_stage(self.profiler, "finalize",
+                                         "finalize") as prof:
+                sol = self._exe_finalize(skey)(qp, scaled_full,
+                                               scaling_full, buf, *l1_args)
+            stage_s["finalize"] += prof["seconds"]
 
         iters = np.asarray(jax.device_get(sol.iters))
+        solve_wall = time.perf_counter() - t_solve0
         status = np.asarray(jax.device_get(sol.status))
         segs = iter_segments(iters, self.params.check_interval)
         useful = int(segs.sum())
@@ -426,6 +462,16 @@ class CompactingDriver:
         executed = int(sum(sizes))
         with self._lock:
             compiled = self.compiles - compiles0
+        try:
+            device = self.device if self.device is not None \
+                else jax.devices()[0]
+            kind = str(device.device_kind)
+        except Exception:  # noqa: BLE001 - labeling never fails a solve
+            kind = ""
+        profile = _profile.qp_solve_profile(
+            n, m, float(iters.mean()) if iters.size else 0.0, solve_wall,
+            params=self.params, batch=B, factor_rows=fr,
+            device_kind=kind, stage_seconds=stage_s)
         report = CompactionReport(
             batch=B,
             segments=len(sizes),
@@ -437,6 +483,7 @@ class CompactingDriver:
             dispatch_sizes=tuple(sizes),
             compiles=compiled,
             max_iter_lanes=int(np.sum(status == Status.MAX_ITER)),
+            profile=profile,
         )
         return sol, report
 
@@ -453,7 +500,8 @@ def solve_batch_compacted(qp: CanonicalQP,
                           x0=None, y0=None,
                           l1_weight=None, l1_center=None,
                           compact: bool = True,
-                          driver: Optional[CompactingDriver] = None):
+                          driver: Optional[CompactingDriver] = None,
+                          harvest=None):
     """One-shot convenience over :class:`CompactingDriver`; returns
     ``(QPSolution, CompactionReport)``. Pass a ``driver`` to reuse its
     executable cache across calls (the bench A/B does) — its
@@ -461,7 +509,10 @@ def solve_batch_compacted(qp: CanonicalQP,
     against them; silently solving at the driver's params instead
     would hand back results at the wrong tolerance). The
     ``segment_budget`` is forwarded per call either way (a runtime
-    operand, no recompile)."""
+    operand, no recompile). ``harvest`` (a
+    :class:`porqua_tpu.obs.HarvestSink`) appends one SolveRecord per
+    lane with the report's compaction accounting and stage profile
+    attached — the telemetry warehouse's ``batch.compacted`` source."""
     if driver is None:
         driver = CompactingDriver(params, segment_budget=segment_budget)
     elif driver.params != params:
@@ -469,6 +520,24 @@ def solve_batch_compacted(qp: CanonicalQP,
             "the shared driver was built for different SolverParams "
             "than this call requests; construct a CompactingDriver "
             "with these params (or omit driver)")
-    return driver.solve(qp, x0=x0, y0=y0, l1_weight=l1_weight,
-                        l1_center=l1_center, compact=compact,
-                        segment_budget=segment_budget)
+    sol, report = driver.solve(qp, x0=x0, y0=y0, l1_weight=l1_weight,
+                               l1_center=l1_center, compact=compact,
+                               segment_budget=segment_budget)
+    if harvest is not None:
+        from porqua_tpu.obs.harvest import device_label_of, harvest_solution
+
+        harvest_solution(
+            harvest, sol, params, "batch.compacted",
+            warm=x0 is not None,
+            warm_src=None if x0 is None else "caller",
+            solve_s=(report.profile or {}).get("seconds"),
+            device=device_label_of(sol),
+            compaction={
+                "lane_segments": report.lane_segments,
+                "dense_lane_segments": report.dense_lane_segments,
+                "useful_lane_segments": report.useful_lane_segments,
+                "segments": report.segments,
+                "compiles": report.compiles,
+            },
+            profile=report.profile)
+    return sol, report

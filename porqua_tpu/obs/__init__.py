@@ -28,6 +28,13 @@ in it runs on the request hot path beyond lock-bounded appends.
 
 from porqua_tpu.obs.events import EventBus, load_jsonl
 from porqua_tpu.obs.exposition import ObsHTTPServer, prometheus_text
+from porqua_tpu.obs.harvest import (
+    HarvestSink,
+    harvest_solution,
+    load_harvest,
+    solve_record,
+)
+from porqua_tpu.obs.profile import StageProfiler, qp_solve_profile
 from porqua_tpu.obs.report import render_report
 from porqua_tpu.obs.rings import ring_history, solution_ring_history
 from porqua_tpu.obs.trace import Span, SpanRecorder
@@ -52,13 +59,19 @@ class Observability:
 
 __all__ = [
     "EventBus",
+    "HarvestSink",
     "Observability",
     "ObsHTTPServer",
     "Span",
     "SpanRecorder",
+    "StageProfiler",
+    "harvest_solution",
+    "load_harvest",
     "load_jsonl",
     "prometheus_text",
+    "qp_solve_profile",
     "render_report",
     "ring_history",
     "solution_ring_history",
+    "solve_record",
 ]

@@ -48,6 +48,7 @@ class EventBus:
         self._events: "collections.deque[Dict[str, Any]]" = (
             collections.deque(maxlen=self.capacity))
         self._dropped = 0                        # guarded-by: self._lock
+        self._sink_failures = 0                  # guarded-by: self._lock
         self._sink = open(path, "a") if path else None
 
     def emit(self, kind: str, severity: str = "info",
@@ -63,16 +64,33 @@ class EventBus:
         if trace_id is not None:
             event["trace_id"] = trace_id
         event.update(fields)
+        # Serialize OUTSIDE the sink try-block (same as HarvestSink):
+        # a json.dumps ValueError is a caller bug in the event fields,
+        # not a dead sink, and must not permanently disable a healthy
+        # stream. Only when a sink exists at all — the common
+        # sink-less bus must not pay per-emit serialization on the
+        # dispatch hot path (the unlocked read is a one-way race:
+        # _sink only ever transitions to None).
+        line = (json.dumps(event, default=str)
+                if self._sink is not None else None)
         with self._lock:
             if len(self._events) == self.capacity:
                 self._dropped += 1  # deque evicts the oldest
             self._events.append(event)
-            if self._sink is not None:
+            if self._sink is not None and line is not None:
                 try:
-                    self._sink.write(json.dumps(event, default=str) + "\n")
+                    self._sink.write(line + "\n")
                     self._sink.flush()
-                except OSError:
-                    self._sink = None  # dead sink: keep serving
+                except (OSError, ValueError):
+                    # ValueError: write on a file something already
+                    # closed (shutdown races included) — same posture.
+                    # Dead sink: keep serving, but COUNT the failure —
+                    # from the scrape's point of view a silently-dead
+                    # stream sink looks identical to a healthy idle one
+                    # otherwise (the counter is exported via
+                    # /metrics and /healthz by SolveService).
+                    self._sink_failures += 1
+                    self._sink = None
         return event
 
     # -- readers -----------------------------------------------------
@@ -81,6 +99,11 @@ class EventBus:
     def dropped(self) -> int:
         with self._lock:
             return self._dropped
+
+    @property
+    def sink_failures(self) -> int:
+        with self._lock:
+            return self._sink_failures
 
     def events(self, kind: Optional[str] = None,
                min_severity: str = "debug") -> List[Dict[str, Any]]:

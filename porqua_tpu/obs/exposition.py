@@ -29,8 +29,30 @@ def _metric_name(prefix: str, key: str) -> str:
     return f"{prefix}_{_NAME_RE.sub('_', key)}"
 
 
+def _histogram_lines(name: str, hist: Dict[str, Any]) -> list:
+    """Render one histogram as cumulative ``_bucket``/``_sum``/
+    ``_count`` series (Prometheus histogram semantics: each ``le``
+    bucket counts every observation <= its bound, ``+Inf`` == count).
+    ``hist`` is :meth:`porqua_tpu.serve.metrics.ServeMetrics.
+    histograms` state — per-bucket (non-cumulative) counts with the
+    overflow bucket last."""
+    lines = [f"# TYPE {name} histogram"]
+    cum = 0
+    for le, count in zip(hist["le"], hist["counts"]):
+        cum += int(count)
+        le_s = f"{float(le):g}"
+        lines.append(f'{name}_bucket{{le="{le_s}"}} {cum}')
+    cum += int(hist["counts"][-1])
+    lines.append(f'{name}_bucket{{le="+Inf"}} {cum}')
+    lines.append(f"{name}_sum {float(hist['sum'])}")
+    lines.append(f"{name}_count {int(hist['count'])}")
+    return lines
+
+
 def prometheus_text(snapshot: Dict[str, Any],
-                    prefix: str = "porqua_serve") -> str:
+                    prefix: str = "porqua_serve",
+                    histograms: Optional[Dict[str, Dict[str, Any]]] = None,
+                    extra_counters: Optional[Dict[str, Any]] = None) -> str:
     """Render one metrics snapshot as Prometheus exposition text.
 
     Every numeric snapshot key is exported; keys in the window-counter
@@ -39,6 +61,15 @@ def prometheus_text(snapshot: Dict[str, Any],
     should treat window resets like process restarts), everything else
     ``gauge``. ``degraded`` exports as 0/1 and ``device`` as a labeled
     ``_device_info`` gauge.
+
+    ``histograms`` renders real cumulative-histogram series
+    (``<prefix>_<name>_bucket{le=...}`` / ``_sum`` / ``_count`` —
+    :meth:`ServeMetrics.histograms` state) next to the percentile
+    gauges, which stay for backward compatibility. ``extra_counters``
+    exports observability-plane counters that live outside the
+    snapshot (``EventBus.dropped``, harvest sink failures, span
+    drops) as ``counter`` series — a saturated bounded bus is
+    invisible to a scraper otherwise.
     """
     # Imported lazily: serve imports obs, so a module-level import here
     # would be circular; at call time both modules are initialized.
@@ -56,6 +87,16 @@ def prometheus_text(snapshot: Dict[str, Any],
         name = _metric_name(prefix, key)
         kind = "counter" if key in counters else "gauge"
         lines.append(f"# TYPE {name} {kind}")
+        lines.append(f"{name} {value}")
+    for key, hist in (histograms or {}).items():
+        lines.extend(_histogram_lines(_metric_name(prefix, key), hist))
+    for key, value in (extra_counters or {}).items():
+        if isinstance(value, bool):
+            value = int(value)
+        if not isinstance(value, (int, float)):
+            continue
+        name = _metric_name(prefix, key)
+        lines.append(f"# TYPE {name} counter")
         lines.append(f"{name} {value}")
     device = snapshot.get("device")
     if device:

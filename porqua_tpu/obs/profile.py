@@ -1,0 +1,234 @@
+"""Per-solve stage profiling: timing hooks + roofline estimates.
+
+The round-5 finding that the whole serving plane runs memory-bound at
+MFU < 3% came from one hand-run roofline; this module makes the same
+accounting continuous. Two halves:
+
+* :class:`StageProfiler` — host-side stage accounting around the
+  solver's device dispatches (``init`` / ``segment_step`` / ``repack``
+  / ``finalize`` in the compacting driver, ``admit`` / ``segment_step``
+  / ``finalize`` in the continuous batcher, ``solve_batch`` in the
+  classic one). Each bracketed region also enters a
+  ``jax.profiler.TraceAnnotation``, so an XLA device trace captured in
+  the same run (:func:`porqua_tpu.profiling.device_trace`) carries
+  matching ``porqua/<stage>`` annotations, and
+  :func:`chrome_counter_events` exports the accumulated stage seconds
+  as Chrome-trace **counter tracks** that render alongside the request
+  spans of :mod:`porqua_tpu.obs.trace` (same anchor, same file).
+  Stage seconds are honest only up to dispatch asynchrony: the
+  bracketed drivers sync at every segment boundary (the compaction
+  active-count readout / the continuous status fetch), so in practice
+  the brackets cover dispatch + completion.
+
+* :func:`qp_solve_profile` — the per-solve MFU / HBM-bandwidth
+  estimate: the analytic cost of the dispatched program from
+  :func:`porqua_tpu.profiling.admm_flop_model` (``window=0`` drops
+  the Gram/TE stages a pure QP solve never runs; a factored objective
+  passes its row count as the window, which is exactly T for tracking
+  problems) against measured seconds and the chip's public peaks.
+  Exported into SolveRecords (``profile`` field) by the harvest
+  producers.
+
+Everything here is host code around already-dispatched programs — the
+GC105 contract (:func:`porqua_tpu.analysis.contracts.
+check_telemetry_identity`) pins that a live profiler changes no traced
+program.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from porqua_tpu.analysis import tsan
+
+__all__ = [
+    "StageProfiler",
+    "annotate",
+    "chrome_counter_events",
+    "profiled_stage",
+    "qp_solve_profile",
+]
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """``jax.profiler.TraceAnnotation`` when jax is importable (a no-op
+    unless a profiler trace is actually being captured), nullcontext
+    otherwise — so pure-host consumers (tests, report tooling) can use
+    the same brackets without initializing a backend."""
+    try:
+        import jax
+
+        cm = jax.profiler.TraceAnnotation(name)
+    except Exception:  # pragma: no cover - jax-version dependent
+        cm = contextlib.nullcontext()
+    with cm:
+        yield
+
+
+@contextlib.contextmanager
+def profiled_stage(profiler, name: str, annotation: str):
+    """The ONE dispatch bracket every driver uses: enter the
+    ``porqua/<annotation>`` jax-profiler annotation, time the block,
+    feed ``profiler`` (a :class:`StageProfiler`, or ``None`` for
+    annotation-only), and expose the elapsed seconds to the caller —
+    ``with profiled_stage(p, "serve/solve_batch", "solve_batch") as h:
+    ...; solve_s = h["seconds"]``. Centralized so the stage name, the
+    annotation, the clock, and the observe call cannot drift apart
+    across the compaction / classic / continuous drivers."""
+    holder = {"seconds": 0.0}
+    t0 = time.monotonic()
+    with annotate(f"porqua/{annotation}"):
+        try:
+            yield holder
+        except BaseException:
+            # A raising dispatch (device fault, sanitizer trip) still
+            # reports its elapsed time to the caller but is NOT a
+            # stage sample — failed dispatches would skew the
+            # per-stage device-seconds the counter tracks render.
+            holder["seconds"] = time.monotonic() - t0
+            raise
+        t1 = time.monotonic()
+        holder["seconds"] = t1 - t0
+        if profiler is not None:
+            profiler.observe(name, holder["seconds"], t_end=t1)
+
+
+class StageProfiler:
+    """Thread-safe per-stage seconds/counts accumulator.
+
+    One instance is shared by a serve stack or a driver; stages are
+    cheap (one monotonic pair + a lock-bounded add), and the sample
+    log (for counter tracks) is bounded like every other obs buffer.
+    """
+
+    def __init__(self, sample_capacity: int = 65536) -> None:
+        self._lock = tsan.lock("StageProfiler")
+        # guarded-by: self._lock
+        self._stages: Dict[str, Dict[str, float]] = {}
+        # (t_mono_end, stage, cumulative_seconds); guarded-by: self._lock
+        self._samples: List[Tuple[float, str, float]] = []
+        self._sample_capacity = int(sample_capacity)
+        self._samples_dropped = 0          # guarded-by: self._lock
+
+    def observe(self, name: str, seconds: float,
+                t_end: Optional[float] = None) -> None:
+        with self._lock:
+            slot = self._stages.setdefault(
+                name, {"seconds": 0.0, "count": 0.0})
+            slot["seconds"] += float(seconds)
+            slot["count"] += 1.0
+            if len(self._samples) < self._sample_capacity:
+                self._samples.append(
+                    (time.monotonic() if t_end is None else float(t_end),
+                     name, slot["seconds"]))
+            else:
+                self._samples_dropped += 1
+
+    @contextlib.contextmanager
+    def stage(self, name: str):
+        """Bracket one device dispatch: times the block and enters the
+        matching ``porqua/<name>`` jax profiler annotation."""
+        t0 = time.monotonic()
+        with annotate(f"porqua/{name}"):
+            try:
+                yield
+            finally:
+                t1 = time.monotonic()
+                self.observe(name, t1 - t0, t_end=t1)
+
+    # -- readers -----------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "stages": {k: dict(v) for k, v in self._stages.items()},
+                "samples": len(self._samples),
+                "samples_dropped": self._samples_dropped,
+            }
+
+    def stage_seconds(self) -> Dict[str, float]:
+        with self._lock:
+            return {k: v["seconds"] for k, v in self._stages.items()}
+
+    def samples(self) -> List[Tuple[float, str, float]]:
+        with self._lock:
+            return list(self._samples)
+
+
+def chrome_counter_events(profiler: StageProfiler,
+                          anchor_mono: float,
+                          pid: Optional[int] = None) -> List[Dict]:
+    """Export the profiler's sample log as Chrome-trace ``"C"``
+    (counter) events on the SAME time anchor as a
+    :class:`~porqua_tpu.obs.trace.SpanRecorder` export — append them
+    to that recorder's ``traceEvents`` and Perfetto renders cumulative
+    per-stage device-seconds tracks under the request spans."""
+    import os
+
+    pid = os.getpid() if pid is None else pid
+    return [{
+        "name": f"porqua/profile/{name}",
+        "cat": "profile",
+        "ph": "C",
+        "ts": (t - anchor_mono) * 1e6,
+        "pid": pid,
+        "args": {"seconds": round(cum, 6)},
+    } for t, name, cum in profiler.samples()]
+
+
+def qp_solve_profile(n: int, m: int, iters: float, seconds: float,
+                     params=None,
+                     batch: int = 1,
+                     factor_rows: Optional[int] = None,
+                     window: Optional[int] = None,
+                     device_kind: str = "",
+                     stage_seconds: Optional[Dict[str, float]] = None
+                     ) -> Dict[str, Any]:
+    """Analytic FLOPs/bytes of the dispatched batch + achieved rates.
+
+    ``seconds`` is the measured wall of the WHOLE ``batch``-lane
+    dispatch; the model multiplies per-lane cost by ``batch``
+    (``admm_flop_model(n_dates=batch)``), so achieved figures describe
+    the dispatch, which every lane's record shares. ``window`` (or a
+    factored objective's ``factor_rows``, which equals T for tracking
+    problems) re-enables the Gram-assembly accounting; the default 0
+    counts only what a pure QP solve runs. MFU fields appear only when
+    the device kind maps to known peaks (TPUs) — on XLA-CPU the record
+    carries the analytic cost and achieved rates alone, which is
+    exactly what a later chip window needs for comparison."""
+    from porqua_tpu.profiling import admm_flop_model, roofline_report
+    from porqua_tpu.qp.solve import SolverParams
+
+    params = SolverParams() if params is None else params
+    T = int(window if window is not None
+            else (factor_rows if factor_rows is not None else 0))
+    model = admm_flop_model(
+        int(n), int(m), T, float(max(iters, 1.0)), int(batch),
+        check_interval=params.check_interval,
+        scaling_iters=params.scaling_iters,
+        scaling_mode=params.scaling_mode,
+        polish_passes=params.polish_passes if params.polish else 0,
+        linsolve="trinv" if params.linsolve == "auto" else params.linsolve,
+        woodbury_refine=params.woodbury_refine,
+    )
+    out: Dict[str, Any] = {
+        "flops_est": model["flops_total"],
+        "bytes_est": model["bytes_total"],
+        "seconds": float(seconds),
+        "batch": int(batch),
+    }
+    if seconds > 0:
+        roof = roofline_report(model, float(seconds), device_kind)
+        out["achieved_tflops"] = roof["achieved_tflops"]
+        out["achieved_hbm_gbps"] = roof["achieved_hbm_gbps"]
+        for key in ("mfu_bf16_peak", "mfu_f32_est", "hbm_utilization",
+                    "roofline_bound"):
+            if key in roof:
+                out[key] = roof[key]
+    if stage_seconds:
+        out["stage_seconds"] = {k: round(v, 6)
+                                for k, v in stage_seconds.items()}
+    return out

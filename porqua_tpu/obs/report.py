@@ -245,6 +245,61 @@ def faults_section(events: Sequence[Dict[str, Any]],
     return "\n".join(lines)
 
 
+#: Status code -> short label for harvest convergence classes (mirrors
+#: qp.admm.Status; literal so the report stays backend-free).
+_STATUS_LABELS = {1: "solved", 2: "max_iter", 3: "primal_infeasible",
+                  4: "dual_infeasible"}
+
+
+def harvest_section(records: Sequence[Dict[str, Any]],
+                    max_rings_per_class: int = 3) -> str:
+    """Convergence analytics from a harvest dataset: ring-trajectory
+    sparklines grouped per terminal-status class (a stalled MAX_ITER
+    trajectory looks nothing like a converging one — the at-a-glance
+    view of WHY the tail is slow), then the per-(bucket, eps)
+    wasted-iteration attribution table the learned-policy work trains
+    against (full aggregation: ``scripts/harvest_report.py``)."""
+    from porqua_tpu.obs.harvest import aggregate
+
+    records = list(records)
+    if not records:
+        return "harvest: (no records)"
+    lines = [f"harvest convergence analytics ({len(records)} records)"]
+    by_class: Dict[str, List[Dict[str, Any]]] = {}
+    for rec in records:
+        if rec.get("ring"):
+            label = _STATUS_LABELS.get(int(rec.get("status", 0)),
+                                       str(rec.get("status")))
+            by_class.setdefault(label, []).append(rec)
+    if not by_class:
+        lines.append("  (no ring trajectories in the dataset — "
+                     "harvest with SolverParams(ring_size>0))")
+    for label in sorted(by_class):
+        recs = by_class[label]
+        lines.append(f"  {label}: {len(recs)} trajectories")
+        for rec in recs[:max_rings_per_class]:
+            ring = rec["ring"]
+            who = rec.get("trace_id") or f"lane {rec.get('lane', '?')}"
+            lines.append(
+                f"    {who}: {rec['iters']} iters, final prim "
+                f"{rec['prim_res']:.2e} dual {rec['dual_res']:.2e}")
+            lines.append(f"      prim {sparkline(ring['prim_res'], log=True)}")
+            lines.append(f"      dual {sparkline(ring['dual_res'], log=True)}")
+    agg = aggregate(records)
+    lines.append("  wasted-iteration attribution by (bucket, eps):")
+    for g in agg["groups"]:
+        eps = g["eps_abs"]
+        wc = g.get("warm_minus_cold_iters_mean")
+        lines.append(
+            f"    {g['bucket']:<12} eps "
+            f"{(f'{eps:.0e}' if eps is not None else '-'):>7}  "
+            f"x{g['count']:<5} iters p50/p95 "
+            f"{g['iters']['p50']:.0f}/{g['iters']['p95']:.0f}  "
+            f"wasted {g['wasted_iteration_fraction']:.3f}"
+            + (f"  warm-cold {wc:+.1f} iters" if wc is not None else ""))
+    return "\n".join(lines)
+
+
 def events_section(events: Sequence[Dict[str, Any]],
                    max_shown: int = 12) -> str:
     """Severity rollup + the most recent warn/error lines."""
@@ -267,7 +322,8 @@ def events_section(events: Sequence[Dict[str, Any]],
 
 def render_report(trace: Any = None,
                   events: Optional[Sequence[Dict[str, Any]]] = None,
-                  snapshot: Optional[Dict[str, Any]] = None) -> str:
+                  snapshot: Optional[Dict[str, Any]] = None,
+                  harvest: Optional[Sequence[Dict[str, Any]]] = None) -> str:
     """The full text report from whichever artifacts exist."""
     sections = []
     if snapshot is not None:
@@ -278,7 +334,10 @@ def render_report(trace: Any = None,
         sections.append(convergence_section(events))
         sections.append(faults_section(events))
         sections.append(events_section(events))
+    if harvest is not None:
+        sections.append(harvest_section(harvest))
     if not sections:
-        return "obs_report: no artifacts given (need --trace/--events/--metrics)"
+        return ("obs_report: no artifacts given "
+                "(need --trace/--events/--metrics/--harvest)")
     rule = "-" * 64
     return f"\n{rule}\n".join(sections)

@@ -96,6 +96,13 @@ class SpanRecorder:
     # -- readers -----------------------------------------------------
 
     @property
+    def anchor_mono(self) -> float:
+        """The monotonic instant ``ts=0`` of this recorder's Chrome
+        export maps to — other producers (the stage profiler's counter
+        tracks) export against it so one trace file lines up."""
+        return self._anchor_mono
+
+    @property
     def dropped(self) -> int:
         with self._lock:
             return self._dropped

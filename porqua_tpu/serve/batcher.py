@@ -34,6 +34,9 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from porqua_tpu.analysis import sanitize, tsan
+from porqua_tpu.obs import profile as _profile
+from porqua_tpu.obs.harvest import solve_record
+from porqua_tpu.obs.rings import ring_history
 from porqua_tpu.qp.admm import Status
 from porqua_tpu.qp.canonical import CanonicalQP, stack_qps
 from porqua_tpu.resilience import faults as _faults
@@ -97,6 +100,9 @@ class SolveRequest:
     submitted: float                 # monotonic seconds
     deadline: Optional[float] = None  # monotonic seconds, None = none
     warm_key: Optional[str] = None
+    # Where the warm key came from ("explicit" | "fingerprint") — the
+    # warm-start provenance harvest records carry; None = no key.
+    warm_src: Optional[str] = None
     trace_id: Optional[str] = None   # obs span correlation id
 
 
@@ -175,11 +181,20 @@ class MicroBatcher:
                  max_wait_ms: float = 2.0,
                  queue_capacity: int = 4096,
                  warm_cache: Optional[WarmStartCache] = None,
-                 obs=None) -> None:
+                 obs=None,
+                 harvest=None,
+                 profiler=None) -> None:
         self.cache = cache
         self.health = health
         self.metrics = metrics
         self.obs = obs  # optional porqua_tpu.obs.Observability
+        # Optional porqua_tpu.obs.HarvestSink: one SolveRecord per
+        # resolved request (problem features + outcome + decoded ring
+        # trajectory). None = zero overhead, bit-identical programs.
+        self.harvest = harvest
+        # Optional porqua_tpu.obs.StageProfiler: dispatch stages
+        # bracketed with jax.profiler trace annotations + counters.
+        self.profiler = profiler
         self.max_batch = int(max_batch)
         self.max_wait_s = float(max_wait_ms) * 1e-3
         self.queue: "queue.Queue[Optional[SolveRequest]]" = queue.Queue(
@@ -336,7 +351,7 @@ class MicroBatcher:
         out = self._execute(bucket, slots, dtype, qp, x0, y0, live)
         if out is None:
             return
-        sol, device_label, solve_s = out
+        sol, device_label, solve_s, device_kind = out
         t_exec1 = time.monotonic()
 
         xs = np.asarray(sol.x)
@@ -356,6 +371,17 @@ class MicroBatcher:
               else np.asarray(sol.ring_prim))
         rd = None if rp is None else np.asarray(sol.ring_dual)
         rr = None if rp is None else np.asarray(sol.ring_rho)
+        profile = None
+        if self.harvest is not None:
+            # Per-dispatch roofline estimate, shared by the dispatch's
+            # lanes (the device ran ONE batched program): analytic cost
+            # of this bucket's solve at this width vs measured seconds.
+            fr = (None if getattr(qp, "Pf", None) is None
+                  else int(np.shape(qp.Pf)[-2]))
+            profile = _profile.qp_solve_profile(
+                bucket.n, bucket.m, float(iters[:len(live)].mean()),
+                solve_s, params=self.cache.params, batch=slots,
+                factor_rows=fr, device_kind=device_kind)
         done = time.monotonic()
         for i, r in enumerate(live):
             # Spans are recorded BEFORE the future resolves: a caller
@@ -374,21 +400,31 @@ class MicroBatcher:
                                  trace_id=r.trace_id)
             self._finish_request(r, bucket, i, xs, ys, status, iters,
                                  prim, dual, obj, rp, rd, rr, done,
-                                 device_label, warm[i])
+                                 device_label, warm[i],
+                                 solve_s=solve_s, profile=profile)
         m.observe_batch(len(live), slots, solve_s,
                         float(iters[:len(live)].mean()))
+
+    #: Harvest-record provenance tag (the continuous batcher overrides).
+    harvest_source = "serve"
 
     def _finish_request(self, r: SolveRequest, bucket: Bucket, i: int,
                         xs, ys, status, iters, prim, dual, obj,
                         rp, rd, rr, done: float, device_label: str,
-                        warm_started: bool) -> None:
+                        warm_started: bool,
+                        segments: Optional[int] = None,
+                        solve_s: Optional[float] = None,
+                        profile: Optional[dict] = None) -> None:
         """Shared per-request retirement: warm-start cache put, the
-        latency / completed / per-lane-Status metrics, and future
-        resolution with the trimmed, copied :class:`SolveResult`. One
-        copy for both batchers (the continuous batcher retires lanes
-        at segment boundaries through this exact sequence), so a new
-        metric or result field cannot land in one path only. Callers
-        record their spans BEFORE calling."""
+        latency / completed / per-lane-Status metrics, the harvest
+        record, and future resolution with the trimmed, copied
+        :class:`SolveResult`. One copy for both batchers (the
+        continuous batcher retires lanes at segment boundaries through
+        this exact sequence), so a new metric or result field cannot
+        land in one path only. Callers record their spans BEFORE
+        calling. ``segments``/``solve_s``/``profile`` enrich the
+        harvest record where the caller knows them (classic dispatch:
+        device seconds + roofline; continuous: executed segments)."""
         m = self.metrics
         ok = int(status[i]) == Status.SOLVED
         if (ok and r.warm_key is not None and self.warm_cache is not None
@@ -404,6 +440,27 @@ class MicroBatcher:
         # solved counts alone cannot distinguish a MAX_ITER lane from
         # a converged one.
         m.observe_status(int(status[i]))
+        m.observe_request_iters(int(iters[i]))
+        if self.harvest is not None:
+            params = self.cache.params
+            ring = None
+            if rp is not None:
+                ring = ring_history(rp[i], rd[i], rr[i], int(iters[i]),
+                                    params.check_interval)
+            self.harvest.emit(solve_record(
+                self.harvest_source, r.n_orig, r.m_orig,
+                int(status[i]), int(iters[i]), float(prim[i]),
+                float(dual[i]), float(obj[i]), params=params,
+                bucket=f"{bucket.n}x{bucket.m}", warm=warm_started,
+                # Provenance only on lanes that actually warm-started
+                # (a cold first-touch under an explicit key is cold) —
+                # the same invariant harvest_solution keeps, so
+                # warm_src presence is a reliable warm-membership key.
+                warm_src=r.warm_src if warm_started else None,
+                wall_s=done - r.submitted,
+                solve_s=solve_s, device=device_label,
+                trace_id=r.trace_id, ring=ring, segments=segments,
+                profile=profile))
         r.future.set_result(SolveResult(
             # Copy: the row slice is a view whose .base is the whole
             # (slots, n) batch array — a caller retaining results
@@ -444,14 +501,18 @@ class MicroBatcher:
                         device=(f"{device.platform}:{device.id}"
                                 if device is not None else "default"))
                 exe = self.cache.get(bucket, slots, dtype, device)
-                t0 = time.perf_counter()
-                sol = self._call_executable(exe, device, qp, x0, y0)
-                np.asarray(sol.status)  # force completion, honestly timed
-                solve_s = time.perf_counter() - t0
+                with _profile.profiled_stage(
+                        self.profiler, "serve/solve_batch",
+                        "solve_batch") as prof:
+                    sol = self._call_executable(exe, device, qp, x0, y0)
+                    np.asarray(sol.status)  # force completion, honestly timed
+                solve_s = prof["seconds"]
                 self.health.record_success()
                 label = (f"{device.platform}:{device.id}"
                          if device is not None else "default")
-                return sol, label, solve_s
+                kind = (str(device.device_kind)
+                        if device is not None else "")
+                return sol, label, solve_s, kind
             except sanitize.SanitizerError as exc:
                 # A sanitizer policy violation (e.g. a post-warmup
                 # compile demand) is not a device fault: fail THIS

@@ -55,6 +55,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from porqua_tpu.analysis import sanitize
+from porqua_tpu.obs import profile as _profile
 from porqua_tpu.qp.admm import Status
 from porqua_tpu.qp.canonical import CanonicalQP, stack_qps
 from porqua_tpu.resilience import faults as _faults
@@ -163,6 +164,9 @@ class ContinuousBatcher(MicroBatcher):
     default is the solver's own ``ceil(max_iter / check_interval)``,
     i.e. pure ``max_iter`` semantics.
     """
+
+    #: Harvest-record provenance tag (continuous-mode retirements).
+    harvest_source = "serve.continuous"
 
     def __init__(self, *args, params=None,
                  segment_budget: Optional[int] = None, **kwargs) -> None:
@@ -388,10 +392,12 @@ class ContinuousBatcher(MicroBatcher):
         if cohort.staged:
             mask = np.zeros(cohort.slots, bool)
             mask[cohort.staged] = True
-            out = self._call(
-                cohort.admit_exe, cohort.device, cohort.qp_stack,
-                cohort.x0, cohort.y0, mask, cohort.scaled,
-                cohort.scaling, cohort.carry)
+            with _profile.profiled_stage(self.profiler, "serve/admit",
+                                         "admit"):
+                out = self._call(
+                    cohort.admit_exe, cohort.device, cohort.qp_stack,
+                    cohort.x0, cohort.y0, mask, cohort.scaled,
+                    cohort.scaling, cohort.carry)
             cohort.qp_dev, cohort.scaled, cohort.scaling, cohort.carry = out
             cohort.active[cohort.staged] = True
             m.inc("lanes_admitted", len(cohort.staged))
@@ -414,16 +420,19 @@ class ContinuousBatcher(MicroBatcher):
                          bucket=f"{bucket.n}x{bucket.m}",
                          slots=cohort.slots)
         active_dev = cohort.active.copy()
-        carry, status, _iters = self._call(
-            cohort.step_exe, cohort.device, cohort.scaled,
-            cohort.scaling, cohort.carry, active_dev)
-        cohort.carry = carry
-        # The per-boundary control readout: ONE small explicit d2h
-        # fetch (the repack/step program itself is sync-free — the
-        # GC101-103 contracts trace it). Final iteration counts come
-        # from the finalize output at retirement; fetching the step's
-        # iters here would be a second blocking sync nothing reads.
-        status_h = np.asarray(jax.device_get(status))
+        with _profile.profiled_stage(self.profiler, "serve/segment_step",
+                                     "segment_step"):
+            carry, status, _iters = self._call(
+                cohort.step_exe, cohort.device, cohort.scaled,
+                cohort.scaling, cohort.carry, active_dev)
+            cohort.carry = carry
+            # The per-boundary control readout: ONE small explicit d2h
+            # fetch (the repack/step program itself is sync-free — the
+            # GC101-103 contracts trace it). Final iteration counts come
+            # from the finalize output at retirement; fetching the
+            # step's iters here would be a second blocking sync nothing
+            # reads.
+            status_h = np.asarray(jax.device_get(status))
         step_s = time.monotonic() - t0
         n_live = int(np.sum(active_dev & np.array(
             [r is not None for r in cohort.reqs])))
@@ -449,8 +458,11 @@ class ContinuousBatcher(MicroBatcher):
         if not retire:
             return
 
-        sol = self._call(cohort.fin_exe, cohort.device, cohort.qp_dev,
-                         cohort.scaled, cohort.scaling, cohort.carry.state)
+        with _profile.profiled_stage(self.profiler, "serve/finalize",
+                                     "finalize"):
+            sol = self._call(cohort.fin_exe, cohort.device, cohort.qp_dev,
+                             cohort.scaled, cohort.scaling,
+                             cohort.carry.state)
         t_fin = time.monotonic()
         # Fetch ONLY the retiring lanes' rows: the finalize output
         # covers the whole cohort, but under steady load a boundary
@@ -494,7 +506,8 @@ class ContinuousBatcher(MicroBatcher):
                                       trace_id=r.trace_id)
             self._finish_request(r, bucket, j, xs, ys, fstat, fit,
                                  prim, dual, obj, rp, rd, rr, done,
-                                 device_label, cohort.warm[i])
+                                 device_label, cohort.warm[i],
+                                 segments=int(cohort.seg_count[i]))
             cohort.reqs[i] = None
             cohort.write_slot(i, cohort.neutral)
             cohort.active[i] = False

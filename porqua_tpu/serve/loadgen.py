@@ -98,6 +98,7 @@ def run_loadgen(requests: List[CanonicalQP],
                 events_out: Optional[str] = None,
                 ring_size: int = 0,
                 ring_samples: int = 8,
+                harvest_out: Optional[str] = None,
                 continuous: bool = False,
                 segment_budget: Optional[int] = None,
                 retry=None,
@@ -123,9 +124,18 @@ def run_loadgen(requests: List[CanonicalQP],
     (JSONL). ``ring_size`` compiles the service's executables with
     on-device convergence rings and emits a ``convergence_ring`` event
     for the first ``ring_samples`` completed requests — the data
-    ``scripts/obs_report.py`` renders as sparklines. Both artifacts
-    require the service to be created here (an external ``service``
-    carries its own ``obs``).
+    ``scripts/obs_report.py`` renders as sparklines. ``harvest_out``
+    appends one :mod:`porqua_tpu.obs.harvest` SolveRecord per resolved
+    request to the JSONL(.gz) dataset at that path (the telemetry
+    warehouse ``scripts/harvest_report.py`` aggregates; pair with
+    ``ring_size`` to persist full residual trajectories); with
+    ``trace_out`` a :class:`~porqua_tpu.obs.profile.StageProfiler`
+    also runs and its stage-seconds counter tracks are merged into
+    the trace file. ``ring_size`` and ``harvest_out`` require the
+    service to be created here (``harvest_out`` against an external
+    service raises — the sink is wired at construction); ``trace_out``/
+    ``events_out`` write from whatever ``obs`` the service carries,
+    external or not.
 
     Resilience: ``retry`` (a :class:`porqua_tpu.resilience.RetryPolicy`)
     routes every request through the service's recovery layer — the
@@ -179,6 +189,8 @@ def run_loadgen(requests: List[CanonicalQP],
             retry = RetryPolicy()
 
     obs = None
+    sink = None
+    profiler = None
     own_service = service is None
     if own_service:
         if ring_size:
@@ -187,15 +199,45 @@ def run_loadgen(requests: List[CanonicalQP],
             from porqua_tpu.obs import Observability
 
             obs = Observability()
+        if harvest_out:
+            # The telemetry warehouse: one SolveRecord per resolved
+            # request, appended to the JSONL(.gz) dataset at
+            # harvest_out. Sink failures surface in the report and
+            # (when obs is on) as harvest_sink_failed events.
+            from porqua_tpu.obs import HarvestSink
+
+            sink = HarvestSink(harvest_out,
+                               events=None if obs is None else obs.events)
+        if trace_out:
+            # Stage profiler: per-dispatch stage seconds exported as
+            # Chrome-trace counter tracks in the same trace file as
+            # the request spans (and as jax.profiler annotations when
+            # a device trace is being captured).
+            from porqua_tpu.obs import StageProfiler
+
+            profiler = StageProfiler()
         service = SolveService(params=params, max_batch=max_batch,
                                max_wait_ms=max_wait_ms,
                                queue_capacity=max(4 * max_batch, 1024),
                                obs=obs, continuous=continuous,
                                segment_budget=segment_budget,
-                               retry=retry)
+                               retry=retry, harvest=sink,
+                               profiler=profiler)
         service.start()
     else:
         obs = service.obs
+        sink = service.harvest
+        profiler = service.profiler
+        if harvest_out is not None:
+            # The sink is wired at service construction (the batcher
+            # holds it); it cannot be retrofitted or redirected here,
+            # and silently ignoring the request would report a run the
+            # caller believes produced a dataset. Same posture as the
+            # retry-policy mismatch above.
+            raise ValueError(
+                "harvest_out requires the service to be constructed "
+                "here; build it with SolveService(harvest="
+                "HarvestSink(path)) and read that sink directly")
         if service._retry is None:
             # A retry policy is applied at service construction — it
             # cannot be retrofitted here, and silently dropping it
@@ -236,6 +278,12 @@ def run_loadgen(requests: List[CanonicalQP],
         for t in warm_tickets:
             service.result(t, timeout=120)
         service.metrics.reset_window()
+        # The harvest sink saw the warmup round too (it is wired at
+        # service construction, and the dataset SHOULD keep those
+        # records — cold-compile-adjacent solves are data); remember
+        # the boundary so the report can reconcile the measured
+        # window's record count against the metrics' `completed`.
+        harvest_records0 = sink.records if sink is not None else 0
 
         if scenario is not None:
             # The chaos window opens AFTER prewarm + warmup: faults
@@ -344,6 +392,17 @@ def run_loadgen(requests: List[CanonicalQP],
                 "span_cover_median": round(cov["cover_median"], 4),
                 "span_cover_min": round(cov["cover_min"], 4),
             }
+            if profiler is not None:
+                # Counter tracks on the span recorder's anchor, in the
+                # SAME trace file: Perfetto renders cumulative stage
+                # seconds under the request lanes.
+                from porqua_tpu.obs.profile import chrome_counter_events
+
+                trace["traceEvents"].extend(chrome_counter_events(
+                    profiler, obs.spans.anchor_mono))
+                obs_fields["profile_stages"] = {
+                    k: round(v, 4)
+                    for k, v in profiler.stage_seconds().items()}
             if trace_out:
                 # The trace object was just built for the coverage
                 # stats; dump it directly instead of having
@@ -356,6 +415,17 @@ def run_loadgen(requests: List[CanonicalQP],
             if events_out:
                 obs.events.write_jsonl(events_out)
                 obs_fields["events_out"] = events_out
+        if sink is not None:
+            sink.flush()
+            obs_fields.update({
+                "harvest_out": sink.path,
+                "harvest_records": sink.records,
+                # Records emitted during the measured window alone —
+                # reconciles exactly with the snapshot's `completed`
+                # (every resolved request emits one record).
+                "harvest_records_measured": sink.records - harvest_records0,
+                "harvest_write_failures": sink.write_failures,
+            })
         n = len(requests)
         return {
             **obs_fields,
@@ -409,3 +479,5 @@ def run_loadgen(requests: List[CanonicalQP],
             _faults.uninstall()
         if own_service:
             service.stop()
+            if sink is not None:
+                sink.close()

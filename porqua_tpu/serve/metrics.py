@@ -76,6 +76,15 @@ _STATUS_COUNTER = {
     4: "status_dual_infeasible",
 }
 
+#: Prometheus histogram bucket upper bounds. Cumulative-histogram
+#: series (``_bucket``/``_sum``/``_count``) let a scraper compute ANY
+#: quantile over ANY scrape window server-side; the percentile gauges
+#: in the snapshot stay (backward compatibility), but they describe
+#: only this process's reservoir over its own window.
+LATENCY_BUCKETS_S = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                     0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+ITERS_BUCKETS = (25, 50, 75, 100, 150, 250, 500, 1000, 2000, 4000)
+
 
 class ServeMetrics:
     """Counters + reservoirs for the online solve service."""
@@ -103,6 +112,20 @@ class ServeMetrics:
             self._queue_depth_sum = 0
             self._queue_depth_max = 0
             self._queue_depth_samples = 0
+            # Real Prometheus histograms (solve latency, per-lane
+            # iterations): per-bucket counts + sum + count, windowed
+            # with everything else (scrapers treat window resets like
+            # process restarts, same contract as the counters).
+            self._hist = {
+                "solve_latency_seconds": {
+                    "le": LATENCY_BUCKETS_S,
+                    "counts": [0] * (len(LATENCY_BUCKETS_S) + 1),
+                    "sum": 0.0, "count": 0},
+                "lane_iterations": {
+                    "le": ITERS_BUCKETS,
+                    "counts": [0] * (len(ITERS_BUCKETS) + 1),
+                    "sum": 0.0, "count": 0},
+            }
             self._degraded = getattr(self, "_degraded", False)
             self._device_label: Optional[str] = getattr(
                 self, "_device_label", None)
@@ -175,6 +198,25 @@ class ServeMetrics:
             with self._lock:
                 self.counters[name] += 1
 
+    def _hist_observe(self, name: str, value: float) -> None:  # guarded-by: self._lock
+        h = self._hist[name]
+        i = 0
+        for i, le in enumerate(h["le"]):
+            if value <= le:
+                break
+        else:
+            i = len(h["le"])  # the +Inf bucket
+        h["counts"][i] += 1
+        h["sum"] += float(value)
+        h["count"] += 1
+
+    def observe_request_iters(self, iters: int) -> None:
+        """One request's final device iteration count into the
+        per-lane-iterations histogram (per observation, unlike the
+        ``observe_iters`` window-mean aggregate)."""
+        with self._lock:
+            self._hist_observe("lane_iterations", float(iters))
+
     def observe_queue_wait(self, seconds: float) -> None:
         """Accumulate one request's submit->dispatch wait (the batcher
         observes it at batch formation, so the figure covers queue time
@@ -184,6 +226,7 @@ class ServeMetrics:
 
     def observe_latency(self, seconds: float) -> None:
         with self._lock:
+            self._hist_observe("solve_latency_seconds", float(seconds))
             if len(self._latencies) < self._reservoir_cap:
                 self._latencies.append(seconds)
             else:
@@ -243,6 +286,18 @@ class ServeMetrics:
                     float(np.percentile(lat, pct)) * 1e3 if lat.size else 0.0)
             out["latency_mean_ms"] = float(lat.mean()) * 1e3 if lat.size else 0.0
             return out
+
+    def histograms(self) -> Dict[str, Dict[str, Any]]:
+        """Cumulative histogram state for the Prometheus exposition:
+        ``{name: {"le": bounds, "counts": per-bucket (non-cumulative,
+        +Inf last), "sum": float, "count": int}}``. The renderer
+        (:func:`porqua_tpu.obs.exposition.prometheus_text`) turns the
+        per-bucket counts into the cumulative ``_bucket`` series."""
+        with self._lock:
+            return {name: {"le": tuple(h["le"]),
+                           "counts": list(h["counts"]),
+                           "sum": h["sum"], "count": h["count"]}
+                    for name, h in self._hist.items()}
 
     def write_jsonl(self, path: str) -> Dict[str, Any]:
         """Append one snapshot line to ``path``; returns the snapshot."""

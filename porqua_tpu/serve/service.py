@@ -280,12 +280,25 @@ class SolveService:
                  segment_budget: Optional[int] = None,
                  retry=None,
                  cache: Optional[ExecutableCache] = None,
+                 harvest=None,
+                 profiler=None,
                  **health_kwargs) -> None:
         self.params = params
         self.continuous = bool(continuous)
         self.fingerprint_warm_keys = bool(fingerprint_warm_keys)
         self.ladder = BucketLadder() if ladder is None else ladder
         self.metrics = ServeMetrics() if metrics is None else metrics
+        # Optional porqua_tpu.obs.HarvestSink: every resolved request
+        # becomes one SolveRecord (problem features + outcome + decoded
+        # ring trajectory) in the telemetry warehouse. Pure host
+        # post-processing of arrays the batcher already fetched — the
+        # GC105 contract pins that the compiled programs are identical
+        # with it on or off.
+        self.harvest = harvest
+        # Optional porqua_tpu.obs.StageProfiler shared by the batcher's
+        # dispatch brackets (solve_batch / admit / segment_step /
+        # finalize stages + jax.profiler annotations).
+        self.profiler = profiler
         # Optional porqua_tpu.obs.Observability: spans are recorded for
         # every request (trace ids minted at submit) and structured
         # events emitted by every layer. None = zero overhead.
@@ -330,7 +343,7 @@ class SolveService:
             max_batch=max_batch, max_wait_ms=max_wait_ms,
             queue_capacity=queue_capacity,
             warm_cache=WarmStartCache(warm_capacity) if warm_start else None,
-            obs=obs)
+            obs=obs, harvest=harvest, profiler=profiler)
         if self.continuous:
             # Continuous batching: cohorts step one segment at a time,
             # retire lanes the boundary they converge (or hit the
@@ -374,6 +387,10 @@ class SolveService:
             self.batcher.stop(timeout=timeout)
             if self._retry is not None:
                 self._retry.stop()
+        if self.harvest is not None:
+            # Flush (not close): the sink is caller-owned and may be
+            # shared by a batch driver writing the same dataset.
+            self.harvest.flush()
 
     def start_http(self, port: int = 0, host: str = "127.0.0.1") -> int:
         """Expose ``/metrics`` (Prometheus text) and ``/healthz``
@@ -384,9 +401,27 @@ class SolveService:
 
         if self._http is None:
             self._http = ObsHTTPServer(
-                metrics_fn=lambda: prometheus_text(self.snapshot()),
+                metrics_fn=lambda: prometheus_text(
+                    self.snapshot(),
+                    histograms=self.metrics.histograms(),
+                    extra_counters=self._obs_counters()),
                 health_fn=self._health_payload, host=host, port=port)
         return self._http.start()
+
+    def _obs_counters(self) -> dict:
+        """Observability-plane health counters that live OUTSIDE the
+        metrics snapshot: event-bus drops and sink failures, span
+        drops, harvest sink state. A saturated bounded bus or a dead
+        harvest disk loses data silently from the scrape's point of
+        view unless these are exported."""
+        out: dict = {}
+        if self.obs is not None:
+            out["events_dropped"] = self.obs.events.dropped
+            out["events_sink_failures"] = self.obs.events.sink_failures
+            out["spans_dropped"] = self.obs.spans.dropped
+        if self.harvest is not None:
+            out.update(self.harvest.counters())
+        return out
 
     def _health_payload(self) -> dict:
         # Degraded-but-serving is still ok=True: the breaker exists so
@@ -398,6 +433,10 @@ class SolveService:
             "started": self._started,
             "degraded": self.health.degraded,
             "device": self.metrics.snapshot().get("device"),
+            # Telemetry-plane loss counters: a liveness prober (or a
+            # human) sees event/harvest loss without scraping the full
+            # exposition.
+            **self._obs_counters(),
         }
 
     def __enter__(self) -> "SolveService":
@@ -512,15 +551,17 @@ class SolveService:
                         0.0)
         trace_id = (None if self.obs is None
                     else self.obs.spans.new_trace())
+        warm_src = None if warm_key is None else "explicit"
         if warm_key is None and self.fingerprint_warm_keys:
             warm_key = problem_fingerprint(qp)
+            warm_src = "fingerprint"
         bucket, padded = self.ladder.pad(qp)
         now = time.monotonic()
         req = SolveRequest(
             qp=padded, bucket=bucket, n_orig=qp.n, m_orig=qp.m,
             future=Future(), submitted=now,
             deadline=None if deadline_s is None else now + deadline_s,
-            warm_key=warm_key, trace_id=trace_id)
+            warm_key=warm_key, warm_src=warm_src, trace_id=trace_id)
         try:
             if timeout is None:
                 self.batcher.queue.put(req)

@@ -1,0 +1,347 @@
+#!/usr/bin/env python
+"""Machine-checked bench regression gate: diff a fresh bench payload
+against a committed baseline artifact.
+
+Five rounds of BENCH artifacts (BENCH_r01-r05) were only ever eyeballed;
+this gate makes every future PR's perf claim falsifiable: it compares a
+fresh ``bench.py`` payload (the one-line JSON, or a committed
+``BENCH_rNN.json`` wrapper with its ``parsed`` field) against a
+baseline under per-metric tolerance rules, writes a verdict JSON, and
+exits nonzero on any regression.
+
+Rule classes (the full table: ``RULES`` below / README "Telemetry
+warehouse & bench gate"):
+
+* **invariants** — hard correctness/discipline bars with NO tolerance:
+  steady-state recompiles == 0 (serving and the compaction A/B),
+  compaction bit-parity (``te_drift <= 1e-6``), solved-lane count not
+  below baseline, solver config unchanged (``linsolve``).
+* **quality** — tracking error within a small relative band (solver
+  changes show up here before they show up in wall-clock).
+* **performance** — wall-clock / throughput / iteration-distribution
+  ratios with generous default tolerances (shared CI hosts jitter;
+  ``--tolerance-scale`` tightens or loosens every ratio rule at once
+  for quiet vs noisy environments).
+
+A metric absent from the BASELINE is skipped (older artifacts predate
+newer payload parts — BENCH_r05 has no ``config_serving``); a metric
+the baseline HAS but the candidate lost is a failure (coverage
+regressions count as regressions). ``--selftest`` builds a synthetic
+baseline + a passing and a regressed candidate and asserts both
+verdicts — the cheap CI smoke ``scripts/run_tests.sh`` runs.
+
+Examples::
+
+    python bench.py > /tmp/bench_fresh.json
+    python scripts/bench_gate.py --baseline BENCH_r05.json \\
+        --payload /tmp/bench_fresh.json --out gate_verdict.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: (name, metric path, kind, tolerance, class). Kinds:
+#:   ratio_max  — candidate <= baseline * tol      (lower is better)
+#:   ratio_min  — candidate >= baseline * tol      (higher is better)
+#:   abs_delta  — candidate <= baseline + tol      (fractions near 0)
+#:   eq         — candidate == tol                 (baseline-independent
+#:                invariant; checked whenever the candidate has it)
+#:   le         — candidate <= tol                 (ditto)
+#:   ge_base    — candidate >= baseline            (counts)
+#:   same       — candidate == baseline            (config identity)
+#:   rel_band   — |candidate - baseline| <= tol * |baseline|
+RULES = [
+    # -- invariants (no tolerance): discipline + parity ---------------
+    ("serving_recompiles", "config_serving.recompiles_after_warmup",
+     "eq", 0, "invariant"),
+    ("compaction_recompiles", "config_compaction.recompiles_in_measured_solve",
+     "eq", 0, "invariant"),
+    ("compaction_te_parity", "config_compaction.te_drift",
+     "le", 1e-6, "invariant"),
+    ("solved_lanes", "device_solved", "ge_base", None, "invariant"),
+    ("linsolve_config", "linsolve", "same", None, "invariant"),
+    # -- quality ------------------------------------------------------
+    ("tracking_error", "device_median_te", "rel_band", 0.02, "quality"),
+    # -- performance --------------------------------------------------
+    # Host-normalized: vs_baseline is the device speedup over the SAME
+    # host's serial CPU baseline, so it compares across CI hosts of
+    # different absolute speed (raw seconds vary ~2x between hosts in
+    # this environment and would gate host identity, not the code).
+    ("headline_speedup", "vs_baseline", "ratio_min", 0.7, "performance"),
+    ("steady_state_speedup", "vs_baseline_steady_state",
+     "ratio_min", 0.7, "performance"),
+    ("serving_throughput", "config_serving.throughput_solves_per_s",
+     "ratio_min", 0.6, "performance"),
+    ("serving_p99_ms", "config_serving.latency_p99_ms",
+     "ratio_max", 2.0, "performance"),
+    ("iters_p95", "iters_p95", "ratio_max", 1.1, "performance"),
+    ("wasted_iteration_fraction", "wasted_iteration_fraction",
+     "abs_delta", 0.05, "performance"),
+    ("compaction_reduction", "config_compaction.lane_segments_reduction",
+     "ratio_min", 0.8, "performance"),
+]
+
+#: Ratio tolerances scaled by --tolerance-scale (invariants never are).
+_SCALED_KINDS = ("ratio_max", "ratio_min", "abs_delta", "rel_band")
+
+
+def load_payload(path: str) -> Dict[str, Any]:
+    """Load a bench payload: either the raw one-line JSON ``bench.py``
+    prints, or a committed ``BENCH_rNN.json`` driver wrapper (its
+    ``parsed`` field is the payload)."""
+    with open(path) as f:
+        data = json.load(f)
+    if "parsed" in data and isinstance(data["parsed"], dict):
+        return data["parsed"]
+    return data
+
+
+def _lookup(payload: Dict[str, Any], dotted: str):
+    cur: Any = payload
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def _scale_tol(kind: str, tol, scale: float):
+    if tol is None or kind not in _SCALED_KINDS:
+        return tol
+    if kind == "ratio_min":
+        # 0.6 at scale 1 -> closer to 1 when tightening (scale < 1).
+        return 1.0 - (1.0 - tol) * scale
+    if kind == "ratio_max":
+        return 1.0 + (tol - 1.0) * scale
+    return tol * scale  # abs_delta / rel_band
+
+
+def check_payload(baseline: Dict[str, Any],
+                  candidate: Dict[str, Any],
+                  tolerance_scale: float = 1.0) -> Dict[str, Any]:
+    """Apply every rule; returns the verdict object (``ok`` +
+    per-check rows). Pure — the CLI wraps I/O around it and tests call
+    it directly."""
+    checks: List[Dict[str, Any]] = []
+    for name, path, kind, tol, klass in RULES:
+        base = _lookup(baseline, path)
+        cand = _lookup(candidate, path)
+        tol_eff = _scale_tol(kind, tol, tolerance_scale)
+        row: Dict[str, Any] = {
+            "name": name, "metric": path, "kind": kind,
+            "class": klass, "tolerance": tol_eff,
+            "baseline": base, "candidate": cand,
+        }
+        if kind in ("eq", "le"):
+            # Baseline-independent invariant: enforced whenever the
+            # candidate carries the metric at all.
+            if cand is None:
+                row["status"] = ("fail" if base is not None else "skip")
+                row["detail"] = ("metric present in baseline but missing "
+                                 "from candidate (coverage regression)"
+                                 if base is not None else
+                                 "metric absent from candidate")
+            elif kind == "eq":
+                row["status"] = "pass" if cand == tol_eff else "fail"
+            else:
+                row["status"] = ("pass" if float(cand) <= float(tol_eff)
+                                 else "fail")
+        elif base is None:
+            row["status"] = "skip"
+            row["detail"] = ("metric absent from baseline (older "
+                             "artifact) — recorded, not compared")
+        elif cand is None:
+            row["status"] = "fail"
+            row["detail"] = ("metric present in baseline but missing "
+                             "from candidate (coverage regression)")
+        elif kind == "same":
+            row["status"] = "pass" if cand == base else "fail"
+        elif kind == "ge_base":
+            row["status"] = ("pass" if float(cand) >= float(base)
+                             else "fail")
+        elif kind == "rel_band":
+            denom = abs(float(base)) or 1.0
+            drift = abs(float(cand) - float(base)) / denom
+            row["drift"] = drift
+            row["status"] = "pass" if drift <= tol_eff else "fail"
+        elif kind == "ratio_max":
+            base_f = float(base)
+            ratio = (float(cand) / base_f if base_f
+                     else (math.inf if float(cand) else 1.0))
+            row["ratio"] = ratio
+            row["status"] = "pass" if ratio <= tol_eff else "fail"
+        elif kind == "ratio_min":
+            base_f = float(base)
+            ratio = float(cand) / base_f if base_f else 1.0
+            row["ratio"] = ratio
+            row["status"] = "pass" if ratio >= tol_eff else "fail"
+        elif kind == "abs_delta":
+            row["status"] = ("pass"
+                             if float(cand) <= float(base) + tol_eff
+                             else "fail")
+        else:  # pragma: no cover - rule-table typo guard
+            row["status"] = "fail"
+            row["detail"] = f"unknown rule kind {kind!r}"
+        checks.append(row)
+
+    failed = [c for c in checks if c["status"] == "fail"]
+    return {
+        "ok": not failed,
+        "t": time.time(),
+        "tolerance_scale": tolerance_scale,
+        "checks": checks,
+        "n_pass": sum(c["status"] == "pass" for c in checks),
+        "n_fail": len(failed),
+        "n_skip": sum(c["status"] == "skip" for c in checks),
+        "failed": [c["name"] for c in failed],
+    }
+
+
+def render_verdict(verdict: Dict[str, Any]) -> str:
+    lines = []
+    for c in verdict["checks"]:
+        mark = {"pass": "OK  ", "fail": "FAIL", "skip": "skip"}[c["status"]]
+        detail = ""
+        if "ratio" in c:
+            detail = f" (ratio {c['ratio']:.3f}, tol {c['tolerance']})"
+        elif "drift" in c:
+            detail = f" (drift {c['drift']:.4f}, tol {c['tolerance']})"
+        elif c.get("detail"):
+            detail = f" ({c['detail']})"
+        lines.append(f"{mark} {c['name']:<28} baseline={c['baseline']} "
+                     f"candidate={c['candidate']}{detail}")
+    lines.append(
+        f"{'PASS' if verdict['ok'] else 'FAIL'}: {verdict['n_pass']} pass, "
+        f"{verdict['n_fail']} fail, {verdict['n_skip']} skipped")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# selftest
+# ---------------------------------------------------------------------------
+
+def _synthetic_baseline() -> Dict[str, Any]:
+    return {
+        "value": 3.65, "vs_baseline": 2.6,
+        "vs_baseline_steady_state": 2.6,
+        "device_solved": 252, "device_median_te": 6.138e-4,
+        "linsolve": "trinv", "iters_p95": 25.0,
+        "wasted_iteration_fraction": 0.0,
+        "config_serving": {"throughput_solves_per_s": 3383.0,
+                           "latency_p99_ms": 120.0,
+                           "recompiles_after_warmup": 0},
+        "config_compaction": {"recompiles_in_measured_solve": 0,
+                              "te_drift": 3.2e-9,
+                              "lane_segments_reduction": 0.331},
+    }
+
+
+def _selftest() -> int:
+    base = _synthetic_baseline()
+
+    # An unchanged tree: small jitter inside every tolerance (a
+    # slightly slower host lowers the speedup a touch).
+    good = json.loads(json.dumps(base))
+    good["vs_baseline"] *= 0.9
+    good["config_serving"]["throughput_solves_per_s"] *= 0.92
+    v_good = check_payload(base, good)
+    assert v_good["ok"], f"selftest: clean payload failed: {v_good['failed']}"
+    assert v_good["n_skip"] == 0, v_good
+
+    # A synthetically regressed payload: speedup and throughput
+    # halved, a steady-state recompile, bit-parity broken — every
+    # class of rule must trip its own check.
+    bad = json.loads(json.dumps(base))
+    bad["vs_baseline"] *= 0.5
+    bad["config_serving"]["throughput_solves_per_s"] *= 0.4
+    bad["config_serving"]["recompiles_after_warmup"] = 2
+    bad["config_compaction"]["te_drift"] = 1e-3
+    bad["device_solved"] = 240
+    v_bad = check_payload(base, bad)
+    assert not v_bad["ok"], "selftest: regressed payload passed"
+    for name in ("headline_speedup", "serving_throughput",
+                 "serving_recompiles", "compaction_te_parity",
+                 "solved_lanes"):
+        assert name in v_bad["failed"], \
+            f"selftest: {name} not in {v_bad['failed']}"
+
+    # Baseline-missing metrics skip (old artifacts), candidate-missing
+    # metrics fail (coverage regression).
+    old_base = {"vs_baseline": 2.6, "device_solved": 252,
+                "device_median_te": 6.138e-4, "linsolve": "trinv"}
+    v_old = check_payload(old_base, good)
+    assert v_old["ok"], f"selftest: vs old baseline failed: {v_old['failed']}"
+    assert v_old["n_skip"] > 0, v_old
+    lossy = {k: v for k, v in good.items() if k != "config_serving"}
+    v_lossy = check_payload(base, lossy)
+    assert not v_lossy["ok"] and "serving_throughput" in v_lossy["failed"], \
+        v_lossy["failed"]
+
+    # The committed r05 artifact itself must gate clean against a
+    # candidate equal to it (wrapper form exercised via load_payload).
+    r05 = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "BENCH_r05.json")
+    if os.path.exists(r05):
+        payload = load_payload(r05)
+        v_r05 = check_payload(payload, payload)
+        assert v_r05["ok"], f"selftest: r05 self-gate failed: {v_r05['failed']}"
+
+    print(render_verdict(v_bad))
+    print("\nbench_gate selftest: ok")
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--baseline", default=None,
+                    help="committed baseline (BENCH_rNN.json wrapper "
+                         "or raw payload)")
+    ap.add_argument("--payload", default=None,
+                    help="fresh bench payload to gate (bench.py's JSON "
+                         "line; '-' reads stdin)")
+    ap.add_argument("--out", default=None,
+                    help="write the verdict JSON here")
+    ap.add_argument("--tolerance-scale", type=float, default=1.0,
+                    help="scale every ratio/band tolerance (0.5 = "
+                         "twice as strict; invariants are never scaled)")
+    ap.add_argument("--selftest", action="store_true",
+                    help="synthetic baseline vs passing + regressed "
+                         "payloads; asserts both verdicts")
+    args = ap.parse_args()
+
+    if args.selftest:
+        return _selftest()
+    if not args.baseline or not args.payload:
+        ap.error("--baseline and --payload are required (or --selftest)")
+
+    baseline = load_payload(args.baseline)
+    if args.payload == "-":
+        candidate = json.loads(sys.stdin.read())
+        if "parsed" in candidate and isinstance(candidate["parsed"], dict):
+            candidate = candidate["parsed"]
+    else:
+        candidate = load_payload(args.payload)
+
+    verdict = check_payload(baseline, candidate,
+                            tolerance_scale=args.tolerance_scale)
+    verdict["baseline_path"] = args.baseline
+    verdict["payload_path"] = args.payload
+    print(render_verdict(verdict))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(verdict, f, indent=1)
+        print(f"verdict written to {args.out}")
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,148 @@
+#!/usr/bin/env python
+"""Aggregate a solver-telemetry harvest dataset into the policy table.
+
+Input: one or more JSONL(.gz) datasets written by a
+:class:`porqua_tpu.obs.HarvestSink` (``serve_loadgen.py
+--harvest-out``, ``batch.solve_batch(harvest=...)``, the checkpointed
+scan driver). Output: the policy-ready rollup the ROADMAP's
+learned-adaptive-solver work trains against — per-(bucket, eps)
+iteration quantiles, wasted-iteration attribution, warm-vs-cold
+iteration deltas, status/source breakdowns — as a text table (default)
+or JSON (``--json``), with the full aggregate optionally written to
+``--out``.
+
+``--selftest`` builds a synthetic dataset in-process (no JAX) and
+checks the aggregate + rendering end to end — the CI smoke
+``scripts/run_tests.sh`` runs.
+
+Examples::
+
+    JAX_PLATFORMS=cpu python scripts/serve_loadgen.py \\
+        --harvest-out /tmp/harvest.jsonl --rings 16
+    python scripts/harvest_report.py /tmp/harvest.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def render_table(agg: Dict[str, Any]) -> str:
+    lines = [
+        f"harvest dataset: {agg['records']} records "
+        f"({agg['ring_records']} with ring trajectories)",
+        "sources: " + ", ".join(f"{k} x{v}"
+                                for k, v in sorted(agg["sources"].items())),
+        "",
+        f"{'bucket':<12} {'eps_abs':>9} {'count':>6} {'p50':>6} "
+        f"{'p95':>6} {'max':>6} {'wasted':>7} {'warm':>5} {'cold':>5} "
+        f"{'w-c iters':>9}  status",
+    ]
+    for g in agg["groups"]:
+        eps = g["eps_abs"]
+        wc = g.get("warm_minus_cold_iters_mean")
+        status = ",".join(f"{k}:{v}"
+                          for k, v in sorted(g["status_counts"].items()))
+        lines.append(
+            f"{g['bucket']:<12} "
+            f"{(f'{eps:.0e}' if eps is not None else '-'):>9} "
+            f"{g['count']:>6} {g['iters']['p50']:>6.0f} "
+            f"{g['iters']['p95']:>6.0f} {g['iters']['max']:>6.0f} "
+            f"{g['wasted_iteration_fraction']:>7.3f} "
+            f"{g['warm_count']:>5} {g['cold_count']:>5} "
+            f"{(f'{wc:+.1f}' if wc is not None else '-'):>9}  {status}")
+    return "\n".join(lines)
+
+
+def _selftest() -> int:
+    from porqua_tpu.obs.harvest import (
+        HarvestSink, aggregate, load_harvest, solve_record)
+    from porqua_tpu.qp.solve import SolverParams
+
+    import tempfile
+
+    p_loose = SolverParams(eps_abs=1e-3, eps_rel=1e-3)
+    p_tight = SolverParams(eps_abs=1e-5, eps_rel=1e-5)
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "harvest.jsonl.gz")
+        with HarvestSink(path) as sink:
+            # Two (bucket, eps) groups with a known structure: tight-eps
+            # records straggle (one lane at 500 iters), warm starts
+            # save 50 iters on average.
+            for i in range(16):
+                sink.emit(solve_record(
+                    "serve", 24, 1, 1, 25, 1e-4, 1e-4, -1.0,
+                    params=p_loose, bucket="32x4", warm=False,
+                    ring={"iters": [25], "prim_res": [1e-4],
+                          "dual_res": [1e-4], "rho": [0.1]}))
+            for i in range(8):
+                warm = i % 2 == 0
+                iters = (100 if warm else 150) if i < 7 else 500
+                sink.emit(solve_record(
+                    "batch", 500, 1, 1 if i < 7 else 2, iters,
+                    1e-6, 1e-6, -2.0, params=p_tight, bucket="512x4",
+                    warm=warm, warm_src="explicit" if warm else None))
+        records = load_harvest(path)
+        assert len(records) == 24, len(records)
+
+    agg = aggregate(records)
+    assert agg["records"] == 24 and agg["ring_records"] == 16, agg
+    assert agg["sources"] == {"serve": 16, "batch": 8}, agg["sources"]
+    by_bucket = {g["bucket"]: g for g in agg["groups"]}
+    loose, tight = by_bucket["32x4"], by_bucket["512x4"]
+    assert loose["wasted_iteration_fraction"] == 0.0, loose
+    # 7 lanes at <=6 segments + 1 at 20 segments: the straggler tax.
+    assert tight["wasted_iteration_fraction"] > 0.5, tight
+    assert tight["warm_minus_cold_iters_mean"] < 0, tight
+    assert tight["iters"]["max"] == 500.0, tight
+
+    text = render_table(agg)
+    for needle in ("32x4", "512x4", "1e-05", "serve x16", "batch x8"):
+        assert needle in text, f"selftest: {needle!r} missing:\n{text}"
+    print(text)
+    print("\nharvest_report selftest: ok")
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("datasets", nargs="*",
+                    help="harvest JSONL(.gz) files (HarvestSink output)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the aggregate as JSON instead of a table")
+    ap.add_argument("--out", default=None,
+                    help="also write the aggregate JSON here")
+    ap.add_argument("--selftest", action="store_true",
+                    help="synthetic dataset through aggregate + render")
+    args = ap.parse_args()
+
+    if args.selftest:
+        return _selftest()
+    if not args.datasets:
+        ap.error("give at least one harvest dataset (or --selftest)")
+
+    from porqua_tpu.obs.harvest import aggregate, load_harvest
+
+    records: List[Dict[str, Any]] = []
+    for path in args.datasets:
+        records.extend(load_harvest(path))
+    agg = aggregate(records)
+    agg["datasets"] = list(args.datasets)
+    if args.json:
+        print(json.dumps(agg, indent=1))
+    else:
+        print(render_table(agg))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(agg, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,6 +12,10 @@ Consumes the artifacts a traced run emits and prints one text report:
   ``convergence_ring`` events (``--rings K`` on the load generator).
 * ``--metrics serve.jsonl`` — metrics snapshots
   (``ServeMetrics.write_jsonl``; the last line is rendered).
+* ``--harvest harvest.jsonl[.gz]`` — a telemetry-warehouse dataset
+  (``serve_loadgen.py --harvest-out`` / ``HarvestSink``): convergence
+  sparklines per status class + wasted-iteration attribution by
+  (bucket, eps). The full policy table: ``scripts/harvest_report.py``.
 
 ``--selftest`` builds a synthetic run in-process (no JAX, no service)
 and checks the rendering pipeline end to end — the cheap CI smoke
@@ -84,6 +88,12 @@ def _selftest() -> int:
     assert abs(cov["cover_min"] - 1.0) < 1e-6, cov
     assert sparkline([1e-1, 1e-3, 1e-6], log=True)  # renders non-empty
 
+    # A synthetic harvest dataset: converging vs stalled ring
+    # trajectories across two (bucket, eps) groups, round-tripped
+    # through the real on-disk format (gz) like everything else.
+    from porqua_tpu.obs import HarvestSink, load_harvest, solve_record
+    from porqua_tpu.obs.harvest import aggregate as _aggregate
+
     # Round-trip through the on-disk formats the real artifacts use.
     import tempfile
 
@@ -94,6 +104,30 @@ def _selftest() -> int:
         with open(tpath) as f:
             trace = json.load(f)
         events = load_jsonl(epath)
+        hpath = os.path.join(td, "harvest.jsonl.gz")
+        with HarvestSink(hpath) as sink:
+            for i in range(6):
+                k = i + 2
+                sink.emit(solve_record(
+                    "serve", 24, 1, 1, 25 * k, 10.0 ** -(k + 1),
+                    10.0 ** -(k + 2), -1.0, bucket="32x4",
+                    eps_abs=1e-3, check_interval=25, segments=k,
+                    warm=i % 2 == 0, trace_id=f"h-{i}",
+                    ring={"iters": [25 * (j + 1) for j in range(k)],
+                          "prim_res": [10.0 ** -(j + 1) for j in range(k)],
+                          "dual_res": [10.0 ** -(j + 2) for j in range(k)],
+                          "rho": [0.1] * k}))
+            sink.emit(solve_record(
+                "batch", 500, 1, 2, 2000, 1e-2, 1e-2, 0.0,
+                bucket="512x4", eps_abs=1e-5, check_interval=25,
+                segments=80, lane=7,
+                ring={"iters": [1925, 1950, 1975, 2000],
+                      "prim_res": [1e-2] * 4, "dual_res": [1e-2] * 4,
+                      "rho": [0.1] * 4}))
+        harvest = load_harvest(hpath)
+    assert len(harvest) == 7, len(harvest)
+    agg = _aggregate(harvest)
+    assert agg["records"] == 7 and agg["ring_records"] == 7, agg
 
     snapshot = {"completed": 8, "failed": 0, "expired": 0, "rejected": 0,
                 "throughput_solves_per_s": 1100.0, "latency_p50_ms": 4.2,
@@ -101,12 +135,16 @@ def _selftest() -> int:
                 "occupancy_mean": 0.91, "queue_wait_seconds": 0.03,
                 "solve_seconds": 0.02, "compiles": 0,
                 "device": "cpu:0", "degraded": False}
-    text = render_report(trace=trace, events=events, snapshot=snapshot)
+    text = render_report(trace=trace, events=events, snapshot=snapshot,
+                         harvest=harvest)
     for needle in ("stage waterfall", "queue_wait", "span coverage",
                    "convergence rings", "breaker_open",
                    "latency / throughput", "faults / recovery",
                    "injected serve.dispatch", "retry_scheduled",
-                   "1 open / 1 close -> re-closed"):
+                   "1 open / 1 close -> re-closed",
+                   "harvest convergence analytics", "solved: 6",
+                   "max_iter: 1", "wasted-iteration attribution",
+                   "lane 7"):
         assert needle in text, f"selftest: {needle!r} missing from report"
     print(text)
     print("\nobs_report selftest: ok")
@@ -121,6 +159,9 @@ def main() -> int:
                     help="event JSONL (serve_loadgen --events-out)")
     ap.add_argument("--metrics", default=None,
                     help="metrics snapshot JSONL (last line is rendered)")
+    ap.add_argument("--harvest", default=None,
+                    help="telemetry-warehouse dataset (HarvestSink "
+                         "JSONL/.gz): convergence-analytics section")
     ap.add_argument("--selftest", action="store_true",
                     help="render a synthetic run and verify the pipeline")
     args = ap.parse_args()
@@ -128,9 +169,9 @@ def main() -> int:
     if args.selftest:
         return _selftest()
 
-    from porqua_tpu.obs import load_jsonl, render_report
+    from porqua_tpu.obs import load_harvest, load_jsonl, render_report
 
-    trace = events = snapshot = None
+    trace = events = snapshot = harvest = None
     if args.trace:
         with open(args.trace) as f:
             trace = json.load(f)
@@ -139,8 +180,11 @@ def main() -> int:
     if args.metrics:
         lines = load_jsonl(args.metrics)
         snapshot = lines[-1] if lines else None
+    if args.harvest:
+        harvest = load_harvest(args.harvest)
 
-    print(render_report(trace=trace, events=events, snapshot=snapshot))
+    print(render_report(trace=trace, events=events, snapshot=snapshot,
+                        harvest=harvest))
     return 0
 
 

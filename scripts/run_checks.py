@@ -5,26 +5,30 @@ Usage:
     python scripts/run_checks.py [paths ...] [options]
 
 Defaults to scanning ``porqua_tpu/`` — every package subtree,
-including the observability stack ``porqua_tpu/obs/``, the compaction
-driver ``porqua_tpu/compaction.py``, the continuous batcher
-``porqua_tpu/serve/continuous.py``, and the resilience plane
-``porqua_tpu/resilience/`` (all of which must scan clean with zero
-suppressions, same bar as the solver) — with every AST rule
+including the observability stack ``porqua_tpu/obs/`` (the telemetry
+warehouse ``obs/harvest.py`` and stage profiler ``obs/profile.py``
+among it), the compaction driver ``porqua_tpu/compaction.py``, the
+continuous batcher ``porqua_tpu/serve/continuous.py``, and the
+resilience plane ``porqua_tpu/resilience/`` (all of which must scan
+clean with zero suppressions, same bar as the solver) — with every AST rule
 (GC001-GC010; GC007 enforces the ``if faults.enabled():`` guard on
 every fault-injection seam; GC008-GC010 are the concurrency plane —
 shared state inferred from the thread-root reachability graph, static
 lock-order deadlock detection, and blocking-calls-under-a-lock — whose
 runtime half is the ``PORQUA_TSAN=1`` lock-order sanitizer exercised
 by ``scripts/tsan_smoke.py``) plus the trace-time jaxpr contracts
-(GC101-GC104) against the real batch entry points on the XLA-CPU
+(GC101-GC105) against the real batch entry points on the XLA-CPU
 backend: default solver params, the convergence-ring telemetry
 variant (``SolverParams(ring_size>0)``), the compaction
 step-and-repack program (dense + factored — the machine-checked proof
 the repack introduces no host syncs/transfers), the
-continuous-batching admit/step/finalize triple, and the GC104
+continuous-batching admit/step/finalize triple, the GC104
 fault-injector jaxpr-identity contract (solve/serve programs traced
 with a live injector must be string-identical to the bare traces —
-the "bit-identical when disabled" proof). Exit status: 0 clean,
+the "bit-identical when disabled" proof), and the GC105
+telemetry-identity contract (the same identity bar with a live
+StageProfiler stage + HarvestSink — the harvest/profiling plane adds
+zero callbacks/transfers to any jitted entry). Exit status: 0 clean,
 1 findings, 2 internal/usage error.
 
 Options:
@@ -97,7 +101,8 @@ def main(argv=None) -> int:
                           stats_out=stats if args.stats else None)
 
     if not args.no_contracts and (
-            rules is None or rules & {"GC101", "GC102", "GC103", "GC104"}):
+            rules is None or rules & {"GC101", "GC102", "GC103", "GC104",
+                                      "GC105"}):
         try:
             import jax
 

@@ -46,6 +46,29 @@ else
     fail=1
 fi
 
+# bench_gate: the BENCH-artifact regression differ (synthetic baseline
+# vs passing AND regressed payloads, plus the committed BENCH_r05
+# self-gate) — every future PR's perf claim is checked by this tool,
+# so the tool itself is checked here (README "Telemetry warehouse &
+# bench gate").
+if out=$(timeout 120 python scripts/bench_gate.py --selftest 2>&1); then
+    echo "OK   bench_gate --selftest: $(echo "$out" | tail -1)"
+else
+    echo "FAIL bench_gate --selftest:"
+    echo "$out"
+    fail=1
+fi
+
+# harvest_report: the telemetry-warehouse aggregation (synthetic
+# dataset -> per-(bucket,eps) policy table, no JAX backend).
+if out=$(timeout 120 python scripts/harvest_report.py --selftest 2>&1); then
+    echo "OK   harvest_report --selftest: $(echo "$out" | tail -1)"
+else
+    echo "FAIL harvest_report --selftest:"
+    echo "$out"
+    fail=1
+fi
+
 # chaos suite smoke: 3 fault scenarios against a live SolveService
 # (classic + continuous) with the recovery invariants asserted — any
 # invariant violation exits nonzero (README "Resilience & chaos

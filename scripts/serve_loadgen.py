@@ -65,6 +65,12 @@ def main() -> int:
                     help="write the structured event log (JSONL: "
                          "compiles, breaker transitions, expiries, "
                          "convergence-ring samples)")
+    ap.add_argument("--harvest-out", default=None, metavar="PATH",
+                    help="append one telemetry-warehouse SolveRecord "
+                         "per resolved request to this JSONL dataset "
+                         "(.gz gzips; aggregate with "
+                         "scripts/harvest_report.py; pair with --rings "
+                         "to persist residual trajectories)")
     ap.add_argument("--rings", type=int, default=0, metavar="K",
                     help="compile with K-slot on-device convergence "
                          "rings and emit ring events for a sample of "
@@ -138,6 +144,7 @@ def main() -> int:
         warm_keys=args.warm_keys, deadline_s=args.deadline_s,
         jsonl_path=args.jsonl, trace_out=args.trace_out,
         events_out=args.events_out, ring_size=args.rings,
+        harvest_out=args.harvest_out,
         continuous=args.continuous, segment_budget=args.segment_budget,
         retry=retry, chaos=args.chaos, chaos_seed=args.chaos_seed,
         no_retry=args.no_retry)

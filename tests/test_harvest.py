@@ -1,0 +1,626 @@
+"""Telemetry warehouse + bench gate: harvest records, stage profiling,
+histogram exposition, and the regression gate (ISSUE 7 acceptance)."""
+
+import gzip
+import json
+import os
+import sys
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+from porqua_tpu.obs import (
+    EventBus,
+    HarvestSink,
+    Observability,
+    ObsHTTPServer,
+    StageProfiler,
+    load_harvest,
+    prometheus_text,
+    qp_solve_profile,
+    solve_record,
+)
+from porqua_tpu.obs.harvest import aggregate, harvest_solution
+from porqua_tpu.obs.profile import chrome_counter_events
+from porqua_tpu.obs.report import harvest_section
+from porqua_tpu.obs.rings import ring_history
+from porqua_tpu.qp.canonical import CanonicalQP, stack_qps
+from porqua_tpu.qp.solve import SolverParams, solve_qp_batch
+from porqua_tpu.serve.metrics import ServeMetrics
+
+_SCRIPTS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts")
+
+
+def make_qp(n=6, m=2, seed=0, dtype=np.float32):
+    rng = np.random.default_rng(seed)
+    A = rng.standard_normal((2 * n, n))
+    P = A.T @ A / (2 * n) + np.eye(n)
+    q = rng.standard_normal(n)
+    C = np.concatenate([np.ones((1, n)), rng.standard_normal((m - 1, n))])
+    return CanonicalQP.build(
+        P, q, C=C, l=np.full(m, -1.0), u=np.ones(m),
+        lb=np.zeros(n), ub=np.ones(n), dtype=dtype)
+
+
+def stacked_batch(B=5, n=6, m=2, dtype=np.float32):
+    return stack_qps([make_qp(n, m, seed=s, dtype=dtype)
+                      for s in range(B)])
+
+
+# ---------------------------------------------------------------------------
+# HarvestSink
+# ---------------------------------------------------------------------------
+
+class TestHarvestSink:
+    def test_jsonl_and_gzip_roundtrip(self, tmp_path):
+        p = SolverParams(check_interval=25)
+        for name in ("h.jsonl", "h.jsonl.gz"):
+            path = str(tmp_path / name)
+            with HarvestSink(path) as sink:
+                for i in range(7):
+                    sink.emit(solve_record("serve", 8, 2, 1, 50, 1e-6,
+                                           1e-6, -1.0, params=p))
+                assert sink.records == 7
+                assert sink.write_failures == 0
+            records = load_harvest(path)
+            assert len(records) == 7
+            assert records[0]["segments"] == 2  # ceil(50 / 25)
+            assert records[0]["bucket"] == "8x2"
+        # .gz really is gzip on disk.
+        with gzip.open(str(tmp_path / "h.jsonl.gz"), "rt") as f:
+            assert json.loads(f.readline())["source"] == "serve"
+
+    def test_emit_never_raises_and_counts_failures(self, tmp_path):
+        events = EventBus(capacity=16)
+        path = str(tmp_path / "h.jsonl")
+        sink = HarvestSink(path, events=events)
+        sink.emit(solve_record("batch", 4, 1, 1, 10, 0.0, 0.0, 0.0))
+        # Kill the underlying file handle: the next emit must not
+        # raise, must count the failure, and later emits count drops.
+        sink._sink.close()
+        sink.emit(solve_record("batch", 4, 1, 1, 10, 0.0, 0.0, 0.0))
+        assert sink.write_failures == 1
+        sink.emit(solve_record("batch", 4, 1, 1, 10, 0.0, 0.0, 0.0))
+        assert sink.dropped == 1
+        assert sink.records == 3  # every emit counted
+        assert events.events(kind="harvest_sink_failed")
+        assert sink.counters() == {"harvest_records": 3,
+                                   "harvest_write_failures": 1,
+                                   "harvest_dropped": 1}
+        sink.close()
+
+    def test_unwritable_path_counts_not_raises(self, tmp_path):
+        sink = HarvestSink(str(tmp_path / "nodir" / "h.jsonl"))
+        assert sink.write_failures == 1
+        sink.emit(solve_record("batch", 4, 1, 1, 10, 0.0, 0.0, 0.0))
+        assert sink.records == 1 and sink.dropped == 1
+
+    def test_in_memory_buffer_bounded(self):
+        sink = HarvestSink(buffer_capacity=3)
+        for i in range(5):
+            sink.emit(solve_record("serve", 4, 1, 1, 10, 0.0, 0.0, 0.0))
+        assert sink.records == 5
+        assert len(sink.buffered()) == 3
+        assert sink.dropped == 2
+
+    def test_concurrent_emitters(self, tmp_path):
+        path = str(tmp_path / "h.jsonl")
+        sink = HarvestSink(path)
+        p = SolverParams()
+
+        def emitter(k):
+            for i in range(50):
+                sink.emit(solve_record("serve", 8, 2, 1, 25 * (k + 1),
+                                       1e-6, 1e-6, 0.0, params=p))
+
+        threads = [threading.Thread(target=emitter, args=(k,))
+                   for k in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        sink.close()
+        records = load_harvest(path)
+        assert len(records) == 400 and sink.records == 400
+        # Interleaved writes never tore a line.
+        assert all(r["v"] == 1 for r in records)
+
+
+# ---------------------------------------------------------------------------
+# producers
+# ---------------------------------------------------------------------------
+
+class TestBatchProducers:
+    def test_harvest_disabled_is_bit_identical(self):
+        """The acceptance pin: a harvested solve returns byte-for-byte
+        the arrays an unharvested one does (harvest is host
+        post-processing; the jaxpr half is contract GC105)."""
+        from porqua_tpu.batch import BatchProblems, solve_batch
+
+        params = SolverParams(max_iter=500, polish=False, ring_size=4)
+        problems = BatchProblems(
+            qp=stacked_batch(), rebdates=[str(i) for i in range(5)],
+            universes=[["a"] * 6] * 5, n_assets_max=6)
+        bare = solve_batch(problems, params)
+        sink = HarvestSink()
+        harvested = solve_batch(problems, params, harvest=sink)
+        np.testing.assert_array_equal(np.asarray(bare.x),
+                                      np.asarray(harvested.x))
+        np.testing.assert_array_equal(np.asarray(bare.iters),
+                                      np.asarray(harvested.iters))
+        assert sink.records == 5
+
+    def test_batch_records_match_solution(self):
+        from porqua_tpu.batch import BatchProblems, solve_batch
+
+        params = SolverParams(max_iter=500, polish=False, ring_size=8)
+        problems = BatchProblems(
+            qp=stacked_batch(), rebdates=[str(i) for i in range(5)],
+            universes=[["a"] * 6] * 5, n_assets_max=6)
+        sink = HarvestSink()
+        sol = solve_batch(problems, params, harvest=sink)
+        records = sink.buffered()
+        # Record count == lanes the batch driver solved.
+        assert len(records) == 5
+        iters = np.asarray(sol.iters)
+        prim = np.asarray(sol.prim_res)
+        dual = np.asarray(sol.dual_res)
+        for i, rec in enumerate(records):
+            assert rec["source"] == "batch" and rec["lane"] == i
+            assert rec["iters"] == int(iters[i])
+            assert rec["eps_abs"] == params.eps_abs
+            # The decoded ring's last sample IS the reported residual
+            # (polish off -> bitwise, the rings pin).
+            assert rec["ring"]["prim_res"][-1] == float(prim[i])
+            assert rec["ring"]["dual_res"][-1] == float(dual[i])
+            assert rec["ring"]["rho"][-1] > 0  # the rho trace rides along
+
+    def test_compacted_records_carry_compaction_and_profile(self):
+        from porqua_tpu.compaction import solve_batch_compacted
+
+        params = SolverParams(max_iter=500, eps_abs=1e-6, eps_rel=1e-6,
+                              polish=False, ring_size=4)
+        sink = HarvestSink()
+        sol, report = solve_batch_compacted(stacked_batch(), params,
+                                            harvest=sink)
+        records = sink.buffered()
+        assert len(records) == 5
+        for rec in records:
+            assert rec["source"] == "batch.compacted"
+            comp = rec["compaction"]
+            assert comp["lane_segments"] == report.lane_segments
+            assert comp["dense_lane_segments"] == report.dense_lane_segments
+            prof = rec["profile"]
+            assert prof["flops_est"] > 0 and prof["bytes_est"] > 0
+            assert set(prof["stage_seconds"]) == {
+                "init", "segment_step", "finalize"}
+        # The report itself carries the same profile object.
+        assert report.profile["batch"] == 5
+
+    def test_scan_driver_harvest(self, tmp_path):
+        from porqua_tpu.batch import FIXED_UNIVERSE
+        from porqua_tpu.checkpoint import solve_scan_l1_checkpointed
+
+        params = SolverParams(max_iter=500, polish=False, ring_size=4)
+        sink = HarvestSink()
+        sol, info = solve_scan_l1_checkpointed(
+            stacked_batch(), 6, np.zeros(6), 0.001,
+            str(tmp_path / "ckpt"), params=params, segment_size=2,
+            harvest=sink, universes=FIXED_UNIVERSE)
+        records = sink.buffered()
+        assert len(records) == 5
+        assert [r["lane"] for r in records] == list(range(5))
+        assert all(r["source"] == "backtest.scan" for r in records)
+        # Date 0 of a fresh run solved from the cold initial carry;
+        # every later date chains the scan-carry warm start.
+        assert records[0]["warm"] is False
+        assert "warm_src" not in records[0]
+        assert all(r["warm"] and r["warm_src"] == "scan_carry"
+                   for r in records[1:])
+        iters = np.asarray(sol.iters)
+        for i, rec in enumerate(records):
+            assert rec["iters"] == int(iters[i])
+        # A resumed run re-harvests nothing (chunks already on disk).
+        sink2 = HarvestSink()
+        solve_scan_l1_checkpointed(
+            stacked_batch(), 6, np.zeros(6), 0.001,
+            str(tmp_path / "ckpt"), params=params, segment_size=2,
+            harvest=sink2, universes=FIXED_UNIVERSE)
+        assert sink2.records == 0
+
+
+class TestServeProducer:
+    def test_loadgen_harvest_reconciles_with_metrics(self, tmp_path):
+        from porqua_tpu.serve.loadgen import (
+            build_tracking_requests, run_loadgen)
+
+        path = str(tmp_path / "harvest.jsonl.gz")
+        requests = build_tracking_requests(40, n_assets=8, window=32)
+        report = run_loadgen(requests, max_batch=16, ring_size=8,
+                             harvest_out=path, warm_keys=True)
+        assert report["errors"] == 0
+        assert report["harvest_write_failures"] == 0
+        # Measured-window record count == solves ServeMetrics observed.
+        assert report["harvest_records_measured"] == 40
+        records = load_harvest(path)
+        assert len(records) == report["harvest_records"]
+        by_trace = {r["trace_id"]: r for r in records}
+        assert len(by_trace) == len(records)  # per-request identity
+        for rec in records:
+            assert rec["source"] == "serve"
+            assert rec["n"] == 8
+            assert rec["solve_s"] > 0 and rec["wall_s"] > 0
+            # Final ring sample matches the reported residuals (AOT
+            # serve path: within one f32 ulp — same bar as test_obs).
+            assert rec["ring"]["prim_res"][-1] == pytest.approx(
+                rec["prim_res"], rel=1e-5)
+            assert rec["ring"]["dual_res"][-1] == pytest.approx(
+                rec["dual_res"], rel=1e-5)
+            prof = rec["profile"]
+            assert prof["flops_est"] > 0 and prof["batch"] >= 1
+
+    def test_harvest_out_external_service_raises(self):
+        from porqua_tpu.serve import BucketLadder, SolveService
+        from porqua_tpu.serve.loadgen import (
+            build_tracking_requests, run_loadgen)
+
+        svc = SolveService(params=SolverParams(max_iter=200, polish=False),
+                           ladder=BucketLadder((8, 16), (4, 8)),
+                           max_batch=4)
+        reqs = build_tracking_requests(2, n_assets=8, window=16)
+        with svc:
+            with pytest.raises(ValueError, match="harvest_out"):
+                run_loadgen(reqs, service=svc, harvest_out="/tmp/x.jsonl")
+
+    def test_continuous_retirement_emits_segments(self):
+        from porqua_tpu.serve import BucketLadder, SolveService
+
+        params = SolverParams(max_iter=500, eps_abs=1e-5, eps_rel=1e-5,
+                              polish=False, ring_size=4)
+        sink = HarvestSink()
+        profiler = StageProfiler()
+        svc = SolveService(params=params,
+                           ladder=BucketLadder((8, 16), (4, 8)),
+                           max_batch=4, max_wait_ms=5.0,
+                           continuous=True, harvest=sink,
+                           profiler=profiler)
+        with svc:
+            results = [svc.solve(make_qp(seed=s), timeout=120)
+                       for s in range(4)]
+        assert all(r.found for r in results)
+        records = sink.buffered()
+        assert len(records) == 4
+        iters_by_status = np.asarray([r.iters for r in results])
+        for rec in records:
+            assert rec["source"] == "serve.continuous"
+            assert rec["segments"] >= 1
+            assert rec["iters"] in iters_by_status
+        stages = profiler.stage_seconds()
+        assert {"serve/admit", "serve/segment_step",
+                "serve/finalize"} <= set(stages)
+
+    def test_warm_start_provenance(self):
+        from porqua_tpu.serve import BucketLadder, SolveService
+
+        params = SolverParams(max_iter=500, polish=False)
+        sink = HarvestSink()
+        svc = SolveService(params=params,
+                           ladder=BucketLadder((8, 16), (4, 8)),
+                           max_batch=4, max_wait_ms=2.0, harvest=sink)
+        qp = make_qp(seed=3)
+        with svc:
+            svc.solve(qp, warm_key="book-1", timeout=120)
+            svc.solve(qp, warm_key="book-1", timeout=120)
+        recs = sink.buffered()
+        assert len(recs) == 2
+        # Cold first touch under an explicit key: warm False AND no
+        # provenance — warm_src presence is the warm-membership key.
+        assert recs[0]["warm"] is False
+        assert "warm_src" not in recs[0]
+        assert recs[1]["warm"] is True
+        assert recs[1]["warm_src"] == "explicit"
+
+
+# ---------------------------------------------------------------------------
+# profiling
+# ---------------------------------------------------------------------------
+
+class TestProfile:
+    def test_stage_profiler_and_counter_tracks(self):
+        prof = StageProfiler()
+        with prof.stage("segment_step"):
+            pass
+        with prof.stage("segment_step"):
+            pass
+        with prof.stage("finalize"):
+            pass
+        snap = prof.snapshot()
+        assert snap["stages"]["segment_step"]["count"] == 2
+        events = chrome_counter_events(prof, anchor_mono=0.0)
+        assert len(events) == 3
+        assert all(e["ph"] == "C" for e in events)
+        names = {e["name"] for e in events}
+        assert names == {"porqua/profile/segment_step",
+                         "porqua/profile/finalize"}
+        # Cumulative: the second segment_step sample >= the first.
+        seg = [e["args"]["seconds"] for e in events
+               if e["name"].endswith("segment_step")]
+        assert seg[1] >= seg[0]
+
+    def test_qp_solve_profile_fields(self):
+        p = SolverParams(polish=False)
+        prof = qp_solve_profile(500, 1, 25.0, 0.05, params=p, batch=252,
+                                factor_rows=252,
+                                device_kind="TPU v5 lite")
+        assert prof["flops_est"] > 0 and prof["bytes_est"] > 0
+        assert 0 < prof["mfu_bf16_peak"] < 1
+        assert prof["roofline_bound"] in ("compute", "memory")
+        # CPU device kinds have no known peaks: rates only, no MFU.
+        prof_cpu = qp_solve_profile(16, 4, 50.0, 0.01, params=p)
+        assert "mfu_bf16_peak" not in prof_cpu
+        assert prof_cpu["achieved_tflops"] > 0
+
+    def test_gc105_telemetry_identity_clean(self):
+        from porqua_tpu.analysis import contracts
+
+        assert contracts.check_telemetry_identity() == []
+
+
+# ---------------------------------------------------------------------------
+# exposition: histograms + obs counters
+# ---------------------------------------------------------------------------
+
+class TestExposition:
+    def test_histogram_series_cumulative(self):
+        m = ServeMetrics()
+        for s in (0.0005, 0.002, 0.002, 0.03, 20.0):
+            m.observe_latency(s)
+        for it in (10, 60, 5000):
+            m.observe_request_iters(it)
+        text = prometheus_text(m.snapshot(), histograms=m.histograms())
+        assert ("# TYPE porqua_serve_solve_latency_seconds histogram"
+                in text)
+        assert 'porqua_serve_solve_latency_seconds_bucket{le="0.001"} 1' \
+            in text
+        assert 'porqua_serve_solve_latency_seconds_bucket{le="0.0025"} 3' \
+            in text
+        assert 'porqua_serve_solve_latency_seconds_bucket{le="+Inf"} 5' \
+            in text
+        assert "porqua_serve_solve_latency_seconds_count 5" in text
+        assert 'porqua_serve_lane_iterations_bucket{le="25"} 1' in text
+        assert 'porqua_serve_lane_iterations_bucket{le="+Inf"} 3' in text
+        # The percentile gauges stayed (backward compatibility).
+        assert "porqua_serve_latency_p99_ms" in text
+        # Sum is exact.
+        h = m.histograms()["solve_latency_seconds"]
+        assert h["sum"] == pytest.approx(20.0345)
+
+    def test_extra_counters_rendered(self):
+        m = ServeMetrics()
+        text = prometheus_text(
+            m.snapshot(),
+            extra_counters={"events_dropped": 3,
+                            "harvest_write_failures": 1})
+        assert "# TYPE porqua_serve_events_dropped counter" in text
+        assert "porqua_serve_events_dropped 3" in text
+        assert "porqua_serve_harvest_write_failures 1" in text
+
+    def test_service_endpoint_histograms_and_healthz_loss_counters(
+            self, tmp_path):
+        from porqua_tpu.serve import BucketLadder, SolveService
+
+        params = SolverParams(max_iter=200, polish=False)
+        obs = Observability(event_capacity=2)
+        sink = HarvestSink()
+        svc = SolveService(params=params,
+                           ladder=BucketLadder((8, 16), (4, 8)),
+                           max_batch=4, obs=obs, harvest=sink)
+        with svc:
+            port = svc.start_http(0)
+            svc.solve(make_qp(seed=11), timeout=120)
+            # Saturate the tiny event bus so dropped > 0.
+            for i in range(5):
+                obs.events.emit("noise", "debug", i=i)
+            text = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10
+            ).read().decode()
+            assert "porqua_serve_solve_latency_seconds_bucket" in text
+            assert "porqua_serve_lane_iterations_bucket" in text
+            assert "porqua_serve_events_dropped" in text
+            assert "porqua_serve_harvest_records 1" in text
+            health = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10).read())
+            assert health["ok"] is True
+            assert health["events_dropped"] >= 1
+            assert health["events_sink_failures"] == 0
+            assert health["harvest_records"] == 1
+            assert health["harvest_write_failures"] == 0
+
+    def test_event_sink_failure_counted(self, tmp_path):
+        bus = EventBus(capacity=8, path=str(tmp_path / "e.jsonl"))
+        bus.emit("ok")
+        bus._sink.close()  # simulate the disk dying under the stream
+        bus.emit("after-death")
+        assert bus.sink_failures == 1
+        bus.emit("still-serving")
+        assert bus.sink_failures == 1  # counted once; bus keeps working
+        assert len(bus.events()) == 3
+
+    @pytest.mark.slow
+    def test_tsan_concurrent_scrapes_and_harvest(self, monkeypatch,
+                                                 tmp_path):
+        """GC008 thread roots: exposition handler threads + harvest
+        emitters contend under PORQUA_TSAN=1 — lock discipline pinned
+        at runtime (any inversion/foreign-release raises and fails
+        the scrape or the emitter thread)."""
+        monkeypatch.setenv("PORQUA_TSAN", "1")
+        # Built AFTER setenv so every lock is a TSanLock.
+        metrics = ServeMetrics()
+        events = EventBus(capacity=64)
+        sink = HarvestSink(str(tmp_path / "h.jsonl"), events=events)
+        server = ObsHTTPServer(
+            metrics_fn=lambda: prometheus_text(
+                metrics.snapshot(), histograms=metrics.histograms(),
+                extra_counters={"events_dropped": events.dropped,
+                                **sink.counters()}),
+            health_fn=lambda: {"ok": True, **sink.counters()})
+        port = server.start()
+        errors = []
+        stop = threading.Event()
+        p = SolverParams()
+
+        def writer(k):
+            try:
+                i = 0
+                while not stop.is_set():
+                    metrics.observe_latency(0.001 * (k + 1))
+                    metrics.observe_request_iters(25 * (k + 1))
+                    sink.emit(solve_record("serve", 8, 2, 1, 25, 1e-6,
+                                           1e-6, 0.0, params=p))
+                    events.emit("tick", i=i)
+                    i += 1
+            except Exception as exc:  # noqa: BLE001 - the assertion
+                errors.append(f"writer: {exc!r}")
+
+        def scraper():
+            try:
+                for _ in range(20):
+                    text = urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metrics",
+                        timeout=10).read().decode()
+                    assert "_bucket" in text
+                    urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz", timeout=10)
+            except Exception as exc:  # noqa: BLE001 - the assertion
+                errors.append(f"scraper: {exc!r}")
+
+        writers = [threading.Thread(target=writer, args=(k,))
+                   for k in range(3)]
+        scrapers = [threading.Thread(target=scraper) for _ in range(3)]
+        for t in writers + scrapers:
+            t.start()
+        for t in scrapers:
+            t.join()
+        stop.set()
+        for t in writers:
+            t.join()
+        server.stop()
+        sink.close()
+        assert not errors, errors
+        assert sink.records > 0 and sink.write_failures == 0
+
+
+# ---------------------------------------------------------------------------
+# aggregation + report section
+# ---------------------------------------------------------------------------
+
+class TestAggregate:
+    def test_policy_table_groups(self):
+        p1 = SolverParams(eps_abs=1e-3, eps_rel=1e-3)
+        p2 = SolverParams(eps_abs=1e-5, eps_rel=1e-5)
+        records = []
+        for i in range(10):
+            records.append(solve_record("serve", 24, 1, 1, 25, 1e-4,
+                                        1e-4, 0.0, params=p1,
+                                        bucket="32x4", warm=i < 5))
+        for i in range(4):
+            records.append(solve_record("batch", 24, 1, 1,
+                                        100 if i < 3 else 400,
+                                        1e-6, 1e-6, 0.0, params=p2,
+                                        bucket="32x4"))
+        agg = aggregate(records)
+        assert agg["records"] == 14
+        assert agg["sources"] == {"serve": 10, "batch": 4}
+        assert len(agg["groups"]) == 2
+        tight = next(g for g in agg["groups"] if g["eps_abs"] == 1e-5)
+        # 3 lanes at 4 segments + 1 at 16: wasted = 1 - 28/64.
+        assert tight["wasted_iteration_fraction"] == pytest.approx(
+            1 - 28 / 64)
+        loose = next(g for g in agg["groups"] if g["eps_abs"] == 1e-3)
+        assert loose["warm_count"] == 5 and loose["cold_count"] == 5
+
+    def test_harvest_section_renders(self):
+        p = SolverParams()
+        records = [solve_record(
+            "serve", 8, 2, 1, 50, 1e-6, 1e-7, 0.0, params=p,
+            trace_id=f"t{i}",
+            ring={"iters": [25, 50], "prim_res": [1e-3, 1e-6],
+                  "dual_res": [1e-4, 1e-7], "rho": [0.1, 0.1]})
+            for i in range(3)]
+        text = harvest_section(records)
+        assert "solved: 3 trajectories" in text
+        assert "wasted-iteration attribution" in text
+        assert "t0" in text
+        assert harvest_section([]) == "harvest: (no records)"
+
+
+# ---------------------------------------------------------------------------
+# bench gate
+# ---------------------------------------------------------------------------
+
+class TestBenchGate:
+    @pytest.fixture()
+    def gate(self):
+        sys.path.insert(0, _SCRIPTS)
+        try:
+            import bench_gate
+        finally:
+            sys.path.remove(_SCRIPTS)
+        return bench_gate
+
+    def test_selftest_passes(self, gate):
+        assert gate._selftest() == 0
+
+    def test_pass_and_fail_verdicts(self, gate):
+        base = gate._synthetic_baseline()
+        good = json.loads(json.dumps(base))
+        good["value"] *= 1.1
+        verdict = gate.check_payload(base, good)
+        assert verdict["ok"] and verdict["n_fail"] == 0
+        bad = json.loads(json.dumps(base))
+        bad["config_compaction"]["te_drift"] = 1e-2
+        bad["iters_p95"] = base["iters_p95"] * 2
+        verdict = gate.check_payload(base, bad)
+        assert not verdict["ok"]
+        assert set(verdict["failed"]) == {"compaction_te_parity",
+                                          "iters_p95"}
+
+    def test_r05_artifact_gates_clean_against_itself(self, gate):
+        r05 = os.path.join(os.path.dirname(_SCRIPTS), "BENCH_r05.json")
+        payload = gate.load_payload(r05)
+        assert "value" in payload  # the wrapper's parsed form
+        verdict = gate.check_payload(payload, payload)
+        assert verdict["ok"], verdict["failed"]
+        # Metrics the r05 artifact predates are skipped, not failed.
+        assert verdict["n_skip"] > 0
+
+    def test_tolerance_scale(self, gate):
+        base = gate._synthetic_baseline()
+        cand = json.loads(json.dumps(base))
+        cand["vs_baseline"] *= 0.75  # inside 0.7x floor, outside 0.94x
+        assert gate.check_payload(base, cand)["ok"]
+        strict = gate.check_payload(base, cand, tolerance_scale=0.2)
+        assert not strict["ok"] and "headline_speedup" in strict["failed"]
+
+    def test_verdict_json_written(self, gate, tmp_path):
+        base = gate._synthetic_baseline()
+        bpath, cpath = tmp_path / "b.json", tmp_path / "c.json"
+        bpath.write_text(json.dumps(base))
+        cpath.write_text(json.dumps(base))
+        out = tmp_path / "verdict.json"
+        # Drive the CLI via argv.
+        argv = sys.argv
+        sys.argv = ["bench_gate.py", "--baseline", str(bpath),
+                    "--payload", str(cpath), "--out", str(out)]
+        try:
+            rc = gate.main()
+        finally:
+            sys.argv = argv
+        assert rc == 0
+        verdict = json.loads(out.read_text())
+        assert verdict["ok"] and verdict["baseline_path"] == str(bpath)
