@@ -42,6 +42,7 @@ except ImportError:  # pragma: no cover - version dependent
 __all__ = [
     "check_closed_jaxpr",
     "check_entry_points",
+    "check_observability_identity",
     "check_resilience_identity",
     "check_run_batch",
     "check_telemetry_identity",
@@ -375,6 +376,97 @@ def check_telemetry_identity(dtype=np.float32) -> List[Finding]:
     return findings
 
 
+def check_observability_identity(dtype=np.float32) -> List[Finding]:
+    """GC106: the live SLO/flight/anomaly plane must be invisible to XLA.
+
+    The live operational plane (:mod:`porqua_tpu.obs.slo`,
+    :mod:`porqua_tpu.obs.flight`, :mod:`porqua_tpu.obs.anomaly`)
+    promises it is pure host bookkeeping over counters and buffers the
+    serve stack already maintains: burn rates from counter deltas,
+    incident bundles from bounded rings, anomaly EWMAs from fetched
+    integers — zero callbacks, zero transfers, zero program edits.
+    This check machine-verifies the enabled half of "disabled ==
+    bit-identical" (the runtime half is pinned by test): the
+    solve / serve / compaction-step entry points are traced bare, then
+    a FULLY LIVE plane is exercised — an SLO engine bound to real
+    metrics fires a burn-rate alert on a stepped clock, the alert's
+    ``slo_alert`` event trips a flight-recorder dump through a real
+    event-bus listener, and an anomaly detector crosses its baseline
+    band and fires — and the entry points are re-traced. The jaxprs
+    must be string-identical.
+    """
+    from porqua_tpu.obs.anomaly import AnomalyDetector
+    from porqua_tpu.obs.events import EventBus
+    from porqua_tpu.obs.flight import FlightRecorder
+    from porqua_tpu.obs.slo import SLOEngine, default_slos
+    from porqua_tpu.resilience.faults import FaultClock
+    from porqua_tpu.serve.metrics import ServeMetrics
+
+    def trace_all():
+        return [
+            ("solve_batch", str(solve_batch_jaxpr(dtype=dtype))),
+            ("serve_entry", str(serve_entry_jaxpr(dtype=dtype))),
+            ("compaction_step", str(compaction_step_jaxpr(dtype=dtype))),
+        ]
+
+    findings: List[Finding] = []
+    baseline = trace_all()
+
+    clock = FaultClock()
+    metrics = ServeMetrics()
+    events = EventBus(capacity=1024)
+    engine = SLOEngine(default_slos(), clock=clock,
+                       min_eval_interval_s=0.0).bind(metrics,
+                                                     events=events)
+    flight = FlightRecorder(out_dir=None, debounce_s=0.0, clock=clock)
+    flight.attach(metrics=metrics, slo=engine)
+    events.add_listener(flight.on_event)
+    detector = AnomalyDetector.from_aggregate(
+        {"groups": [{"bucket": "16x4", "eps_abs": 1e-3,
+                     "iters": {"p50": 50.0, "p95": 100.0, "max": 150.0},
+                     "wasted_iteration_fraction": 0.1, "count": 64}]},
+        min_samples=4, events=events)
+    # Drive the plane hot: a hard availability breach across two
+    # evaluations (so the windows have a real delta), plus anomaly
+    # observations far past the baseline band.
+    engine.evaluate()
+    metrics.inc("completed", 5)
+    metrics.inc("failed", 95)
+    clock.advance(10.0)
+    engine.evaluate()
+    for _ in range(8):
+        detector.observe("16x4", 1e-3, iters=5000, segments=200,
+                         check_interval=25)
+    live = trace_all()
+    post = str(solve_batch_jaxpr(dtype=dtype))
+
+    if engine.status()["alerts_fired"] < 1 or not flight.bundles():
+        findings.append(Finding(
+            "GC106", "<jaxpr:observability_identity>", 0, 0,
+            "the live-plane probe did not exercise itself (no alert "
+            "fired or no bundle dumped) — the identity check proved "
+            "nothing"))
+    if detector.status()["fired"] < 1:
+        findings.append(Finding(
+            "GC106", "<jaxpr:observability_identity>", 0, 0,
+            "the anomaly-detector probe never crossed its baseline "
+            "band — the identity check proved nothing"))
+    for (label, base), (_, lv) in zip(baseline, live):
+        if base != lv:
+            findings.append(Finding(
+                "GC106", f"<jaxpr:{label}>", 0, 0,
+                "traced program differs with the live SLO/flight/"
+                "anomaly plane active: the plane is no longer "
+                "invisible to XLA (disabled-bit-identity contract "
+                "broken)"))
+    if post != baseline[0][1]:
+        findings.append(Finding(
+            "GC106", "<jaxpr:solve_batch>", 0, 0,
+            "traced program differs after a flight-recorder dump — "
+            "the incident plane leaked state into tracing"))
+    return findings
+
+
 def run_batch_jaxpr(bs, params=None, dtype=np.float32) -> ClosedJaxpr:
     """Trace ``run_batch``'s device core against a *real*
     ``BacktestService``: the host pass (``build_problems``) runs for
@@ -457,4 +549,9 @@ def check_entry_points(dtype=np.float32,
     # must produce string-identical programs (harvest/profiling is
     # host post-processing, never traced work).
     findings += check_telemetry_identity(dtype=dtype)
+    # GC106: and for the live operational plane — a firing SLO alert,
+    # a flight-recorder dump, and an anomaly-detector breach must all
+    # leave the traced solve/serve/compaction programs string-
+    # identical (the whole plane is counters-and-rings host code).
+    findings += check_observability_identity(dtype=dtype)
     return findings

@@ -96,6 +96,7 @@ RULE_DOCS = {
     "GC103": "unstable output dtype in a traced program",
     "GC104": "fault injection perturbs a traced program",
     "GC105": "telemetry (harvest/profiling) perturbs a traced program",
+    "GC106": "live plane (SLO/flight/anomaly) perturbs a traced program",
 }
 
 _CONTRACTIONS = {"dot", "einsum", "matmul", "tensordot", "inner", "vdot"}

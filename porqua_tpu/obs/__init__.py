@@ -19,6 +19,15 @@ Three pillars, one package (README "Observability" has the schemas):
   Prometheus text exposition of ``ServeMetrics``, and an optional
   stdlib-HTTP ``/metrics`` + ``/healthz`` endpoint.
 
+On top of the pillars sits the **live operational plane** (README
+"SLOs, alerting & incident response"): :mod:`porqua_tpu.obs.slo`
+(declarative SLOs + multi-window burn-rate alerting),
+:mod:`porqua_tpu.obs.flight` (the incident flight recorder dumping
+debounced, self-contained evidence bundles on triggers), and
+:mod:`porqua_tpu.obs.anomaly` (harvest-calibrated online convergence
+anomaly detection) — wired through ``SolveService(slo=..., flight=...,
+anomaly=...)`` and machine-checked invisible to XLA by contract GC106.
+
 :class:`Observability` bundles one span recorder and one event bus;
 pass it to ``SolveService(obs=...)`` and every layer (batcher,
 executable cache, device health) records through it. The package is
@@ -26,8 +35,10 @@ pure host code — importing it initializes no JAX backend, and nothing
 in it runs on the request hot path beyond lock-bounded appends.
 """
 
+from porqua_tpu.obs.anomaly import AnomalyDetector
 from porqua_tpu.obs.events import EventBus, load_jsonl
 from porqua_tpu.obs.exposition import ObsHTTPServer, prometheus_text
+from porqua_tpu.obs.flight import FlightRecorder, load_bundle
 from porqua_tpu.obs.harvest import (
     HarvestSink,
     harvest_solution,
@@ -37,6 +48,7 @@ from porqua_tpu.obs.harvest import (
 from porqua_tpu.obs.profile import StageProfiler, qp_solve_profile
 from porqua_tpu.obs.report import render_report
 from porqua_tpu.obs.rings import ring_history, solution_ring_history
+from porqua_tpu.obs.slo import SLO, BurnRateRule, SLOEngine, default_slos
 from porqua_tpu.obs.trace import Span, SpanRecorder
 
 
@@ -58,14 +70,21 @@ class Observability:
 
 
 __all__ = [
+    "AnomalyDetector",
+    "BurnRateRule",
     "EventBus",
+    "FlightRecorder",
     "HarvestSink",
     "Observability",
     "ObsHTTPServer",
+    "SLO",
+    "SLOEngine",
     "Span",
     "SpanRecorder",
     "StageProfiler",
+    "default_slos",
     "harvest_solution",
+    "load_bundle",
     "load_harvest",
     "load_jsonl",
     "prometheus_text",

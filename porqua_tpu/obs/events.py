@@ -49,6 +49,8 @@ class EventBus:
             collections.deque(maxlen=self.capacity))
         self._dropped = 0                        # guarded-by: self._lock
         self._sink_failures = 0                  # guarded-by: self._lock
+        self._listeners: List = []               # guarded-by: self._lock
+        self._listener_failures = 0              # guarded-by: self._lock
         self._sink = open(path, "a") if path else None
 
     def emit(self, kind: str, severity: str = "info",
@@ -91,7 +93,29 @@ class EventBus:
                     # /metrics and /healthz by SolveService).
                     self._sink_failures += 1
                     self._sink = None
+            listeners = list(self._listeners) if self._listeners else None
+        if listeners is not None:
+            # OUTSIDE the lock: a listener (the flight recorder's
+            # trigger path) reads this bus and other obs surfaces back
+            # — calling it under the bus lock would self-deadlock and
+            # put every other emitter behind a bundle dump.
+            for fn in listeners:
+                try:
+                    fn(event)
+                except Exception:  # noqa: BLE001 - a broken listener
+                    # must not fail the emitting hot path; count it
+                    # (exported with the other loss counters).
+                    with self._lock:
+                        self._listener_failures += 1
         return event
+
+    def add_listener(self, fn) -> None:
+        """Register a callback invoked (outside the bus lock, on the
+        emitting thread) with every event record — the flight
+        recorder's trigger feed. Listeners must be fast and must not
+        raise; exceptions are swallowed and counted."""
+        with self._lock:
+            self._listeners.append(fn)
 
     # -- readers -----------------------------------------------------
 
@@ -104,6 +128,11 @@ class EventBus:
     def sink_failures(self) -> int:
         with self._lock:
             return self._sink_failures
+
+    @property
+    def listener_failures(self) -> int:
+        with self._lock:
+            return self._listener_failures
 
     def events(self, kind: Optional[str] = None,
                min_severity: str = "debug") -> List[Dict[str, Any]]:

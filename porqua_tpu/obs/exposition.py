@@ -52,7 +52,8 @@ def _histogram_lines(name: str, hist: Dict[str, Any]) -> list:
 def prometheus_text(snapshot: Dict[str, Any],
                     prefix: str = "porqua_serve",
                     histograms: Optional[Dict[str, Dict[str, Any]]] = None,
-                    extra_counters: Optional[Dict[str, Any]] = None) -> str:
+                    extra_counters: Optional[Dict[str, Any]] = None,
+                    extra_gauges: Optional[Dict[str, Any]] = None) -> str:
     """Render one metrics snapshot as Prometheus exposition text.
 
     Every numeric snapshot key is exported; keys in the window-counter
@@ -69,7 +70,10 @@ def prometheus_text(snapshot: Dict[str, Any],
     exports observability-plane counters that live outside the
     snapshot (``EventBus.dropped``, harvest sink failures, span
     drops) as ``counter`` series — a saturated bounded bus is
-    invisible to a scraper otherwise.
+    invisible to a scraper otherwise. ``extra_gauges`` does the same
+    with ``gauge`` typing — the SLO engine's ``slo_burn_rate`` /
+    ``slo_alert_state`` / ``slo_compliance`` series ride this path
+    (:meth:`porqua_tpu.obs.slo.SLOEngine.gauges`).
     """
     # Imported lazily: serve imports obs, so a module-level import here
     # would be circular; at call time both modules are initialized.
@@ -90,14 +94,16 @@ def prometheus_text(snapshot: Dict[str, Any],
         lines.append(f"{name} {value}")
     for key, hist in (histograms or {}).items():
         lines.extend(_histogram_lines(_metric_name(prefix, key), hist))
-    for key, value in (extra_counters or {}).items():
-        if isinstance(value, bool):
-            value = int(value)
-        if not isinstance(value, (int, float)):
-            continue
-        name = _metric_name(prefix, key)
-        lines.append(f"# TYPE {name} counter")
-        lines.append(f"{name} {value}")
+    for kind, extras in (("counter", extra_counters),
+                         ("gauge", extra_gauges)):
+        for key, value in (extras or {}).items():
+            if isinstance(value, bool):
+                value = int(value)
+            if not isinstance(value, (int, float)):
+                continue
+            name = _metric_name(prefix, key)
+            lines.append(f"# TYPE {name} {kind}")
+            lines.append(f"{name} {value}")
     device = snapshot.get("device")
     if device:
         name = _metric_name(prefix, "device_info")

@@ -300,6 +300,58 @@ def harvest_section(records: Sequence[Dict[str, Any]],
     return "\n".join(lines)
 
 
+#: Event kinds rendered on the SLO/alert timeline (alert transitions
+#: interleaved with the breaker and anomaly activity that explains
+#: them).
+_TIMELINE_KINDS = ("slo_alert", "breaker_open", "breaker_close",
+                   "convergence_anomaly")
+
+
+def slo_section(events: Sequence[Dict[str, Any]],
+                max_shown: int = 40) -> str:
+    """The SLO / alert timeline: every ``slo_alert`` state transition
+    (pending -> firing -> resolved, with its burn rates) interleaved
+    chronologically with breaker open/close and convergence-anomaly
+    events — the one view that answers "the alert fired; what was the
+    service doing at that moment"."""
+    rows = [e for e in events if e.get("kind") in _TIMELINE_KINDS]
+    if not rows:
+        return "slo / alert timeline: (no slo, breaker or anomaly events)"
+    rows = sorted(rows, key=lambda e: float(e.get("t", 0.0)))
+    t0 = float(rows[0].get("t", 0.0))
+    lines = ["slo / alert timeline"]
+    # Count over EVERY row (only the tail is rendered): a firing
+    # transition trimmed out of the displayed window must still show
+    # in the totals and the STILL-FIRING verdict.
+    fired = sum(1 for e in rows if e.get("kind") == "slo_alert"
+                and e.get("state") == "firing")
+    resolved = sum(1 for e in rows if e.get("kind") == "slo_alert"
+                   and e.get("state") == "resolved")
+    for e in rows[-max_shown:]:
+        dt = float(e.get("t", 0.0)) - t0
+        kind = e.get("kind")
+        if kind == "slo_alert":
+            state = e.get("state", "?")
+            lines.append(
+                f"  +{dt:8.2f}s  slo_alert  {e.get('slo', '?')}/"
+                f"{e.get('rule', '?')} -> {state}  "
+                f"(burn short {e.get('burn_short', 0.0):.1f} / long "
+                f"{e.get('burn_long', 0.0):.1f}, thr "
+                f"{e.get('threshold', 0.0):g})")
+        elif kind == "convergence_anomaly":
+            lines.append(
+                f"  +{dt:8.2f}s  anomaly    {e.get('bucket', '?')} -> "
+                f"{e.get('state', '?')}  (ewma iters "
+                f"{e.get('ewma_iters', 0.0):g} vs band "
+                f"{e.get('iters_band', 0.0):g})")
+        else:
+            who = e.get("primary") or e.get("device") or "?"
+            lines.append(f"  +{dt:8.2f}s  {kind:<10} {who}")
+    lines.append(f"  alerts: {fired} fired / {resolved} resolved"
+                 + ("  !! STILL FIRING" if fired > resolved else ""))
+    return "\n".join(lines)
+
+
 def events_section(events: Sequence[Dict[str, Any]],
                    max_shown: int = 12) -> str:
     """Severity rollup + the most recent warn/error lines."""
@@ -333,6 +385,7 @@ def render_report(trace: Any = None,
     if events is not None:
         sections.append(convergence_section(events))
         sections.append(faults_section(events))
+        sections.append(slo_section(events))
         sections.append(events_section(events))
     if harvest is not None:
         sections.append(harvest_section(harvest))

@@ -183,7 +183,10 @@ class MicroBatcher:
                  warm_cache: Optional[WarmStartCache] = None,
                  obs=None,
                  harvest=None,
-                 profiler=None) -> None:
+                 profiler=None,
+                 slo=None,
+                 flight=None,
+                 anomaly=None) -> None:
         self.cache = cache
         self.health = health
         self.metrics = metrics
@@ -195,6 +198,15 @@ class MicroBatcher:
         # Optional porqua_tpu.obs.StageProfiler: dispatch stages
         # bracketed with jax.profiler trace annotations + counters.
         self.profiler = profiler
+        # The live operational plane (all optional, all pure host —
+        # contract GC106 pins the compiled programs identical with or
+        # without them): SLOEngine evaluated at retirement boundaries,
+        # FlightRecorder fed recent SolveRecords + metric snapshots,
+        # AnomalyDetector folding per-lane iteration outcomes into its
+        # per-(bucket, eps) EWMAs.
+        self.slo = slo
+        self.flight = flight
+        self.anomaly = anomaly
         self.max_batch = int(max_batch)
         self.max_wait_s = float(max_wait_ms) * 1e-3
         self.queue: "queue.Queue[Optional[SolveRequest]]" = queue.Queue(
@@ -383,6 +395,14 @@ class MicroBatcher:
                 solve_s, params=self.cache.params, batch=slots,
                 factor_rows=fr, device_kind=device_kind)
         done = time.monotonic()
+        # The fused batch steps EVERY lane until the slowest converges
+        # (converged lanes ride frozen): the executed segment count is
+        # the batch maximum, and it is what the anomaly detector's
+        # per-lane waste (1 - iters/(executed*ci)) must divide by —
+        # each lane's own ceil(iters/ci) would read ~zero waste for
+        # every lane and blind the detector to straggler drift.
+        ci = max(int(self.cache.params.check_interval), 1)
+        exec_segs = max(-(-int(iters[:len(live)].max()) // ci), 1)
         for i, r in enumerate(live):
             # Spans are recorded BEFORE the future resolves: a caller
             # synchronizing on result() may export the trace the
@@ -401,9 +421,22 @@ class MicroBatcher:
             self._finish_request(r, bucket, i, xs, ys, status, iters,
                                  prim, dual, obj, rp, rd, rr, done,
                                  device_label, warm[i],
-                                 solve_s=solve_s, profile=profile)
+                                 solve_s=solve_s, profile=profile,
+                                 executed_segments=exec_segs)
         m.observe_batch(len(live), slots, solve_s,
                         float(iters[:len(live)].mean()))
+        self._plane_tick()
+
+    def _plane_tick(self) -> None:
+        """Per-dispatch live-plane upkeep (both batchers call it after
+        a dispatch's retirements): one clock-gated SLO evaluation and
+        one clock-gated flight metric snapshot. Batch-grain on purpose
+        — running these per lane added measurable per-request work for
+        signals that only change per dispatch."""
+        if self.flight is not None:
+            self.flight.maybe_snapshot()
+        if self.slo is not None:
+            self.slo.maybe_evaluate()
 
     #: Harvest-record provenance tag (the continuous batcher overrides).
     harvest_source = "serve"
@@ -414,7 +447,8 @@ class MicroBatcher:
                         warm_started: bool,
                         segments: Optional[int] = None,
                         solve_s: Optional[float] = None,
-                        profile: Optional[dict] = None) -> None:
+                        profile: Optional[dict] = None,
+                        executed_segments: Optional[int] = None) -> None:
         """Shared per-request retirement: warm-start cache put, the
         latency / completed / per-lane-Status metrics, the harvest
         record, and future resolution with the trimmed, copied
@@ -424,7 +458,13 @@ class MicroBatcher:
         land in one path only. Callers record their spans BEFORE
         calling. ``segments``/``solve_s``/``profile`` enrich the
         harvest record where the caller knows them (classic dispatch:
-        device seconds + roofline; continuous: executed segments)."""
+        device seconds + roofline; continuous: executed segments).
+        ``executed_segments`` is the device-executed segment count for
+        the ANOMALY waste signal where it differs from the harvest
+        record's per-lane ``segments`` (classic fused batches execute
+        the batch maximum on every lane; the harvest field keeps the
+        lane's own needed-segment count, which is what the aggregate's
+        straggler attribution is defined over)."""
         m = self.metrics
         ok = int(status[i]) == Status.SOLVED
         if (ok and r.warm_key is not None and self.warm_cache is not None
@@ -441,13 +481,13 @@ class MicroBatcher:
         # a converged one.
         m.observe_status(int(status[i]))
         m.observe_request_iters(int(iters[i]))
-        if self.harvest is not None:
-            params = self.cache.params
+        params = self.cache.params
+        if self.harvest is not None or self.flight is not None:
             ring = None
             if rp is not None:
                 ring = ring_history(rp[i], rd[i], rr[i], int(iters[i]),
                                     params.check_interval)
-            self.harvest.emit(solve_record(
+            rec = solve_record(
                 self.harvest_source, r.n_orig, r.m_orig,
                 int(status[i]), int(iters[i]), float(prim[i]),
                 float(dual[i]), float(obj[i]), params=params,
@@ -460,7 +500,14 @@ class MicroBatcher:
                 wall_s=done - r.submitted,
                 solve_s=solve_s, device=device_label,
                 trace_id=r.trace_id, ring=ring, segments=segments,
-                profile=profile))
+                profile=profile)
+            if self.harvest is not None:
+                self.harvest.emit(rec)
+            if self.flight is not None:
+                # The SAME record the warehouse gets, into the flight
+                # ring — an incident bundle then carries the recent
+                # solve history even when no harvest sink is wired.
+                self.flight.record_solve(rec)
         r.future.set_result(SolveResult(
             # Copy: the row slice is a view whose .base is the whole
             # (slots, n) batch array — a caller retaining results
@@ -479,6 +526,20 @@ class MicroBatcher:
             ring_dual=None if rd is None else np.array(rd[i], copy=True),
             ring_rho=None if rr is None else np.array(rr[i], copy=True),
         ))
+        # Anomaly hook AFTER the future resolves: the caller gets its
+        # answer before this retirement's telemetry can trigger an
+        # (I/O-paying) incident dump. This is THE retirement boundary
+        # for both batchers, so the EWMAs see every lane exactly once
+        # in either mode. (The clock-gated SLO evaluation / flight
+        # snapshot run per DISPATCH in _plane_tick — batch-grain
+        # signals, not per-lane ones.)
+        if self.anomaly is not None:
+            self.anomaly.observe(
+                f"{bucket.n}x{bucket.m}", float(params.eps_abs),
+                int(iters[i]),
+                segments=(segments if executed_segments is None
+                          else executed_segments),
+                check_interval=int(params.check_interval))
 
     def _execute(self, bucket: Bucket, slots: int, dtype, qp, x0, y0,
                  live: List[SolveRequest]):

@@ -513,3 +513,4 @@ class ContinuousBatcher(MicroBatcher):
             cohort.active[i] = False
         self.health.record_success()
         m.observe_iters(float(fit.mean()), len(retire))
+        self._plane_tick()

@@ -104,7 +104,11 @@ def run_loadgen(requests: List[CanonicalQP],
                 retry=None,
                 chaos=None,
                 chaos_seed: int = 0,
-                no_retry: bool = False) -> Dict:
+                no_retry: bool = False,
+                slo=False,
+                slo_latency_target_s: float = 0.25,
+                flight_out=None,
+                anomaly_baseline=None) -> Dict:
     """Drive ``requests`` through a :class:`SolveService`; return the
     report dict (throughput, percentiles, occupancy, recompiles).
 
@@ -161,6 +165,23 @@ def run_loadgen(requests: List[CanonicalQP],
     ``nan_lanes``-corrupted result still resolves with its on-device
     status (typically SOLVED) and is counted as completed — the
     wrong-answer exposure the validation gate exists to close.
+
+    Live operational plane (README "SLOs, alerting & incident
+    response"): ``slo`` (``True`` for the default SLO set at
+    ``slo_latency_target_s``, or a pre-built
+    :class:`porqua_tpu.obs.SLOEngine`) runs multi-window burn-rate
+    alerting over the measured window and adds per-SLO compliance +
+    alert states to the report; ``flight_out`` (a directory, or a
+    pre-built :class:`~porqua_tpu.obs.FlightRecorder`) arms the
+    incident flight recorder — any trigger during the run (breaker
+    open, retry give-up, firing SLO alert, ...) lands one
+    ``incident-*.json.gz`` bundle there (render with
+    ``scripts/incident_report.py``); ``anomaly_baseline`` (a harvest
+    dataset path, or a pre-built
+    :class:`~porqua_tpu.obs.AnomalyDetector`) checks live convergence
+    against per-(bucket, eps) harvest baselines. Like ``harvest_out``,
+    all three wire at service construction, so they require the
+    service to be created here (raises against an external one).
     """
     if mode not in ("closed", "open"):
         raise ValueError(f"unknown mode {mode!r}; expected closed|open")
@@ -191,14 +212,35 @@ def run_loadgen(requests: List[CanonicalQP],
     obs = None
     sink = None
     profiler = None
+    slo_engine = None
+    flight = None
+    anomaly = None
     own_service = service is None
     if own_service:
         if ring_size:
             params = dataclasses.replace(params, ring_size=int(ring_size))
-        if trace_out or events_out or ring_size:
+        if trace_out or events_out or ring_size or slo or flight_out \
+                or anomaly_baseline:
             from porqua_tpu.obs import Observability
 
             obs = Observability()
+        if slo:
+            from porqua_tpu.obs import SLOEngine, default_slos
+
+            slo_engine = (slo if isinstance(slo, SLOEngine)
+                          else SLOEngine(default_slos(
+                              latency_target_s=slo_latency_target_s)))
+        if flight_out:
+            from porqua_tpu.obs import FlightRecorder
+
+            flight = (flight_out if isinstance(flight_out, FlightRecorder)
+                      else FlightRecorder(out_dir=flight_out))
+        if anomaly_baseline:
+            from porqua_tpu.obs import AnomalyDetector
+
+            anomaly = (anomaly_baseline
+                       if isinstance(anomaly_baseline, AnomalyDetector)
+                       else AnomalyDetector.from_harvest(anomaly_baseline))
         if harvest_out:
             # The telemetry warehouse: one SolveRecord per resolved
             # request, appended to the JSONL(.gz) dataset at
@@ -222,12 +264,26 @@ def run_loadgen(requests: List[CanonicalQP],
                                obs=obs, continuous=continuous,
                                segment_budget=segment_budget,
                                retry=retry, harvest=sink,
-                               profiler=profiler)
+                               profiler=profiler, slo=slo_engine,
+                               flight=flight, anomaly=anomaly)
         service.start()
     else:
         obs = service.obs
         sink = service.harvest
         profiler = service.profiler
+        slo_engine = service.slo
+        flight = service.flight
+        anomaly = service.anomaly
+        if slo or flight_out or anomaly_baseline:
+            # Same posture as harvest_out below: the live plane wires
+            # at service construction (the batchers hold the hooks) —
+            # silently ignoring the request would report a run the
+            # caller believes was SLO-monitored / flight-recorded.
+            raise ValueError(
+                "slo/flight_out/anomaly_baseline require the service "
+                "to be constructed here; build it with SolveService("
+                "slo=..., flight=..., anomaly=...) and read those "
+                "objects directly")
         if harvest_out is not None:
             # The sink is wired at service construction (the batcher
             # holds it); it cannot be retrofitted or redirected here,
@@ -370,6 +426,13 @@ def run_loadgen(requests: List[CanonicalQP],
         if jsonl_path:
             service.metrics.write_jsonl(jsonl_path)
 
+        if slo_engine is not None:
+            # Final evaluation BEFORE the event log is dumped: a burn
+            # that crested between the clock-gated per-dispatch
+            # evaluations still lands its slo_alert transitions in the
+            # events_out JSONL (and can still trigger a flight dump).
+            slo_engine.evaluate()
+
         obs_fields: Dict = {}
         if obs is not None:
             from porqua_tpu.obs.report import coverage_stats
@@ -415,6 +478,21 @@ def run_loadgen(requests: List[CanonicalQP],
             if events_out:
                 obs.events.write_jsonl(events_out)
                 obs_fields["events_out"] = events_out
+        if slo_engine is not None:
+            # (The closing evaluation already ran above, before the
+            # event log was written.)
+            obs_fields["slo"] = slo_engine.status()
+        if flight is not None:
+            fc = flight.counters()
+            obs_fields["incident_bundles"] = fc["flight_bundles"]
+            obs_fields["incident_dumps_suppressed"] = (
+                fc["flight_dumps_suppressed"])
+            obs_fields["incident_bundle_paths"] = [
+                p for p in flight.bundles() if isinstance(p, str)][:8]
+        if anomaly is not None:
+            ast = anomaly.status()
+            obs_fields["convergence_anomalies"] = ast["fired"]
+            obs_fields["anomalous_groups"] = ast["anomalous"]
         if sink is not None:
             sink.flush()
             obs_fields.update({

@@ -87,11 +87,26 @@ ITERS_BUCKETS = (25, 50, 75, 100, 150, 250, 500, 1000, 2000, 4000)
 
 
 class ServeMetrics:
-    """Counters + reservoirs for the online solve service."""
+    """Counters + reservoirs for the online solve service.
 
-    def __init__(self, latency_reservoir: int = 65536) -> None:
+    ``latency_buckets`` sets the ``solve_latency_seconds`` histogram
+    bucket upper bounds (strictly increasing, seconds; default
+    :data:`LATENCY_BUCKETS_S`) — a deployment aligns them with its SLO
+    latency targets so the burn-rate engine
+    (:class:`porqua_tpu.obs.slo.SLOEngine`) reads good/bad counts off
+    an exact bucket edge instead of a snapped one.
+    """
+
+    def __init__(self, latency_reservoir: int = 65536,
+                 latency_buckets=LATENCY_BUCKETS_S) -> None:
         self._lock = tsan.lock("ServeMetrics")
         self._reservoir_cap = int(latency_reservoir)
+        buckets = tuple(float(b) for b in latency_buckets)
+        if not buckets or any(b2 <= b1 for b1, b2
+                              in zip(buckets, buckets[1:])):
+            raise ValueError("latency_buckets must be a non-empty, "
+                             "strictly increasing sequence of seconds")
+        self._latency_buckets = buckets
         self.reset_window()
 
     def reset_window(self) -> None:
@@ -118,8 +133,8 @@ class ServeMetrics:
             # process restarts, same contract as the counters).
             self._hist = {
                 "solve_latency_seconds": {
-                    "le": LATENCY_BUCKETS_S,
-                    "counts": [0] * (len(LATENCY_BUCKETS_S) + 1),
+                    "le": self._latency_buckets,
+                    "counts": [0] * (len(self._latency_buckets) + 1),
                     "sum": 0.0, "count": 0},
                 "lane_iterations": {
                     "le": ITERS_BUCKETS,
@@ -298,6 +313,26 @@ class ServeMetrics:
                            "counts": list(h["counts"]),
                            "sum": h["sum"], "count": h["count"]}
                     for name, h in self._hist.items()}
+
+    def slo_sample(self) -> Dict[str, Any]:
+        """The SLO engine's cumulative sample, in ONE lock crossing
+        and with no percentile math: the availability / wrong-answer
+        counters plus the raw latency-histogram state (the engine
+        counts observations at or under its target's bucket edge).
+        Values reset with the window, which the engine detects as a
+        counter regression and restarts its sliding windows from."""
+        with self._lock:
+            h = self._hist["solve_latency_seconds"]
+            return {
+                "completed": self.counters["completed"],
+                "failed": self.counters["failed"],
+                "expired": self.counters["expired"],
+                "retry_giveups": self.counters["retry_giveups"],
+                "validation_failures": self.counters["validation_failures"],
+                "latency_le": tuple(h["le"]),
+                "latency_counts": tuple(h["counts"]),
+                "latency_count": int(h["count"]),
+            }
 
     def write_jsonl(self, path: str) -> Dict[str, Any]:
         """Append one snapshot line to ``path``; returns the snapshot."""

@@ -282,6 +282,9 @@ class SolveService:
                  cache: Optional[ExecutableCache] = None,
                  harvest=None,
                  profiler=None,
+                 slo=None,
+                 flight=None,
+                 anomaly=None,
                  **health_kwargs) -> None:
         self.params = params
         self.continuous = bool(continuous)
@@ -302,8 +305,34 @@ class SolveService:
         # Optional porqua_tpu.obs.Observability: spans are recorded for
         # every request (trace ids minted at submit) and structured
         # events emitted by every layer. None = zero overhead.
+        # The live operational plane (slo / flight / anomaly — README
+        # "SLOs, alerting & incident response") reports through the
+        # event bus: requesting any of it without an Observability
+        # creates one, so alerts and triggers always have somewhere to
+        # land.
+        if obs is None and (slo is not None or flight is not None
+                            or anomaly is not None):
+            from porqua_tpu.obs import Observability
+
+            obs = Observability()
         self.obs = obs
         events = None if obs is None else obs.events
+        self.slo = slo
+        self.flight = flight
+        self.anomaly = anomaly
+        if flight is not None:
+            # The flight recorder observes everything this service
+            # already produces: the metrics snapshot trajectory, the
+            # event/span rings, recent SolveRecords (fed by the
+            # batchers), and the SLO/anomaly status at dump time. Its
+            # trigger feed is the event bus itself.
+            flight.attach(metrics=self.metrics, obs=obs, params=params,
+                          slo=slo, anomaly=anomaly)
+            events.add_listener(flight.on_event)
+        if slo is not None:
+            slo.bind(self.metrics, events=events)
+        if anomaly is not None and anomaly.events is None:
+            anomaly.events = events
         self.health = (DeviceHealth(metrics=self.metrics, events=events,
                                     **health_kwargs)
                        if health is None else health)
@@ -343,7 +372,8 @@ class SolveService:
             max_batch=max_batch, max_wait_ms=max_wait_ms,
             queue_capacity=queue_capacity,
             warm_cache=WarmStartCache(warm_capacity) if warm_start else None,
-            obs=obs, harvest=harvest, profiler=profiler)
+            obs=obs, harvest=harvest, profiler=profiler,
+            slo=slo, flight=flight, anomaly=anomaly)
         if self.continuous:
             # Continuous batching: cohorts step one segment at a time,
             # retire lanes the boundary they converge (or hit the
@@ -404,9 +434,19 @@ class SolveService:
                 metrics_fn=lambda: prometheus_text(
                     self.snapshot(),
                     histograms=self.metrics.histograms(),
-                    extra_counters=self._obs_counters()),
+                    extra_counters=self._obs_counters(),
+                    extra_gauges=self._slo_gauges()),
                 health_fn=self._health_payload, host=host, port=port)
         return self._http.start()
+
+    def _slo_gauges(self) -> Optional[dict]:
+        """Fresh SLO burn-rate / alert-state / compliance gauges for
+        the scrape (an evaluation runs first, clock-gated, so an idle
+        service's burn rates still decay between requests)."""
+        if self.slo is None:
+            return None
+        self.slo.maybe_evaluate()
+        return self.slo.gauges()
 
     def _obs_counters(self) -> dict:
         """Observability-plane health counters that live OUTSIDE the
@@ -418,9 +458,17 @@ class SolveService:
         if self.obs is not None:
             out["events_dropped"] = self.obs.events.dropped
             out["events_sink_failures"] = self.obs.events.sink_failures
+            out["events_listener_failures"] = (
+                self.obs.events.listener_failures)
             out["spans_dropped"] = self.obs.spans.dropped
         if self.harvest is not None:
             out.update(self.harvest.counters())
+        if self.slo is not None:
+            out.update(self.slo.counters())
+        if self.flight is not None:
+            out.update(self.flight.counters())
+        if self.anomaly is not None:
+            out.update(self.anomaly.counters())
         return out
 
     def _health_payload(self) -> dict:
@@ -428,7 +476,7 @@ class SolveService:
         # requests keep completing on the fallback; ejecting the
         # instance for being degraded would turn a slowdown into an
         # outage. ok flips only when the service is not running.
-        return {
+        payload = {
             "ok": self._started,
             "started": self._started,
             "degraded": self.health.degraded,
@@ -438,6 +486,14 @@ class SolveService:
             # exposition.
             **self._obs_counters(),
         }
+        if self.slo is not None:
+            # SLO status from one endpoint: per-SLO compliance, the
+            # current burn rates, and any firing alerts — the chaos
+            # suite and external probes assert degradation here
+            # without scraping and parsing the full exposition.
+            self.slo.maybe_evaluate()
+            payload["slo"] = self.slo.status()
+        return payload
 
     def __enter__(self) -> "SolveService":
         return self.start()

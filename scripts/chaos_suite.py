@@ -26,6 +26,12 @@ recovery invariants per scenario:
                         (``dispatch_failure``, ``validation_failed``,
                         ...) and every injected fault logged a
                         ``fault_injected`` event.
+``incident_bundle``     the per-cell flight recorder
+                        (:mod:`porqua_tpu.obs.flight`) dumped exactly
+                        ONE incident bundle, triggered by the
+                        scenario's expected kind, and the bundle
+                        parses back from disk self-contained (trigger
+                        + counters + event history).
 
 One JSON verdict report (the committed artifact format — see
 ``CHAOS_r06.json``) is printed to stdout and optionally written to
@@ -70,21 +76,38 @@ if "xla_force_host_platform_device_count" not in _flags:
 #: ``feed`` drives the data.feed seam from this suite's submit loop
 #: (the same seam ``loadgen`` compiles in). ``expect_events`` /
 #: ``expect_any_counters`` are the scenario's signature.
+#: ``expect_trigger`` is the flight-recorder incident each scenario
+#: must produce (the incident_bundle invariant: exactly ONE bundle per
+#: cell, dumped by that trigger kind). Scenarios whose signature is an
+#: error-class event use the default trigger inventory; stall-class
+#: scenarios that degrade without an error event extend the cell's
+#: recorder with ``extra_triggers`` (a post-warmup compile IS the
+#: compile_storm incident; the injection marker is queue_stall's —
+#: the scenario's only observable signature).
 SCENARIOS = {
     "device_lost": dict(install="traffic", device_fault=True,
-                        expect_events=("dispatch_failure",)),
+                        expect_events=("dispatch_failure",),
+                        expect_trigger="breaker_open"),
     "probe_blackhole": dict(install="startup", device_fault=True,
-                            expect_events=("probe_failure",)),
+                            expect_events=("probe_failure",),
+                            expect_trigger="breaker_open"),
     "nan_lanes": dict(install="traffic",
                       expect_events=("validation_failed",),
-                      expect_any_counters=("validation_failures",)),
+                      expect_any_counters=("validation_failures",),
+                      expect_trigger="validation_failed"),
     "compile_storm": dict(install="traffic",
-                          expect_any_counters=("compiles",)),
-    "queue_stall": dict(install="traffic"),
+                          expect_any_counters=("compiles",),
+                          expect_trigger="compile",
+                          extra_triggers=("compile",)),
+    "queue_stall": dict(install="traffic",
+                        expect_trigger="fault_injected",
+                        extra_triggers=("fault_injected",)),
     "clock_skew": dict(install="traffic", deadline_s=5.0,
-                       expect_any_counters=("expired", "retry_giveups")),
+                       expect_any_counters=("expired", "retry_giveups"),
+                       expect_trigger="retry_giveup"),
     "feed_corrupt": dict(install="traffic", feed=True,
-                         expect_any_counters=("validation_failures",)),
+                         expect_any_counters=("validation_failures",),
+                         expect_trigger="validation_failed"),
 }
 
 MODES = ("classic", "continuous")
@@ -187,10 +210,28 @@ def run_scenario(name, mode, seed, qps, refs, params, ladder, cache,
     from porqua_tpu.serve.metrics import ServeMetrics
     from porqua_tpu.serve.service import DeviceHealth, SolveService
 
+    import tempfile
+
+    from porqua_tpu.obs.flight import (
+        DEFAULT_TRIGGERS,
+        FlightRecorder,
+        load_bundle,
+    )
+
     cfg = SCENARIOS[name]
     scenario = _faults.builtin_scenarios(seed=seed)[name]
     metrics = ServeMetrics()
     obs = Observability()
+    # The incident flight recorder, per cell: starts DISARMED so
+    # prewarm/warmup activity (cache compiles are a compile_storm
+    # trigger) spends no debounce budget, armed exactly when the
+    # injector installs. debounce_s spans the whole cell, so the
+    # invariant below can demand EXACTLY one bundle; bundles land in a
+    # scratch dir and are parsed back through the real gz round-trip.
+    flight_dir = tempfile.mkdtemp(prefix=f"chaos-{name}-{mode}-")
+    flight = FlightRecorder(
+        out_dir=flight_dir, armed=False, debounce_s=600.0,
+        triggers=DEFAULT_TRIGGERS + tuple(cfg.get("extra_triggers", ())))
     # Re-point the shared executable cache's sinks at THIS run (the
     # cache itself is shared across cells so each scenario does not
     # re-pay the AOT ladder; service.py validates params identity).
@@ -210,7 +251,7 @@ def run_scenario(name, mode, seed, qps, refs, params, ladder, cache,
     service = SolveService(
         params=params, ladder=ladder, max_batch=8, max_wait_ms=5.0,
         queue_capacity=256, metrics=metrics, health=health, obs=obs,
-        continuous=(mode == "continuous"), cache=cache,
+        continuous=(mode == "continuous"), cache=cache, flight=flight,
         retry=RetryPolicy(max_attempts=4, backoff_base_s=0.02,
                           seed=seed))
 
@@ -221,6 +262,7 @@ def run_scenario(name, mode, seed, qps, refs, params, ladder, cache,
     wrong, failures, poisoned_ok = [], [], []
     try:
         if cfg["install"] == "startup":
+            flight.arm()  # startup faults must be recordable incidents
             _faults.install(injector)
             installed = True
         service.start()
@@ -234,6 +276,7 @@ def run_scenario(name, mode, seed, qps, refs, params, ladder, cache,
         metrics.reset_window()
 
         if cfg["install"] == "traffic":
+            flight.arm()  # the chaos window IS the incident window
             _faults.install(injector)
             installed = True
         submitted = 0
@@ -313,6 +356,30 @@ def run_scenario(name, mode, seed, qps, refs, params, ladder, cache,
                 },
             },
         }
+        # Incident flight recorder: every scenario is an incident, and
+        # each cell must have produced EXACTLY one bundle (the
+        # debounce spans the cell), dumped by the scenario's expected
+        # trigger kind, parseable back from disk, and self-contained
+        # enough to carry the trigger + counters + event history.
+        bundle_paths = flight.bundles()
+        bundle_trigger = None
+        bundle_ok = False
+        if len(bundle_paths) == 1:
+            try:
+                bundle = load_bundle(bundle_paths[0])
+                bundle_trigger = bundle["trigger"]["kind"]
+                bundle_ok = (bundle_trigger == cfg["expect_trigger"]
+                             and bundle.get("counters") is not None
+                             and isinstance(bundle.get("events"), list))
+            except Exception as exc:  # noqa: BLE001 - verdict detail
+                bundle_trigger = f"unparseable: {exc!r}"
+        invariants["incident_bundle"] = {
+            "ok": bundle_ok,
+            "detail": {"bundles": len(bundle_paths),
+                       "trigger": bundle_trigger,
+                       "expected": cfg["expect_trigger"],
+                       "suppressed": flight.suppressed},
+        }
         if cfg.get("device_fault"):
             invariants["breaker_cycle"] = {
                 "ok": (kinds.get("breaker_open", 0) >= 1
@@ -352,6 +419,9 @@ def run_scenario(name, mode, seed, qps, refs, params, ladder, cache,
         if installed:
             _faults.uninstall()
         service.stop()
+        import shutil
+
+        shutil.rmtree(flight_dir, ignore_errors=True)
 
 
 def main(argv=None) -> int:

@@ -79,7 +79,22 @@ def _selftest() -> int:
                     reason="deadline", attempts=2, hedges=0,
                     error="DeadlineExpired: budget spent")
     obs.events.emit("hedge_fired", "info", request_id="r3", attempt=1)
+    # SLO/alert timeline: a burn-rate alert firing between the breaker
+    # open and close, a convergence anomaly, then the resolution — the
+    # slo_section must interleave all of it chronologically.
+    obs.events.emit("slo_alert", "error", slo="availability",
+                    rule="fast", state="firing", burn_short=21.3,
+                    burn_long=15.0, threshold=14.4, short_s=300.0,
+                    long_s=3600.0, rule_severity="page")
+    obs.events.emit("convergence_anomaly", "warn", state="firing",
+                    bucket="32x8", eps=1e-3, ewma_iters=912.0,
+                    iters_band=300.0, ewma_waste=0.51, waste_band=0.35,
+                    n=12)
     obs.events.emit("breaker_close", "info", primary="tpu:0")
+    obs.events.emit("slo_alert", "info", slo="availability",
+                    rule="fast", state="resolved", burn_short=0.2,
+                    burn_long=3.1, threshold=14.4, short_s=300.0,
+                    long_s=3600.0, rule_severity="page")
 
     trace = obs.spans.chrome_trace()
     cov = coverage_stats(trace)
@@ -144,7 +159,14 @@ def _selftest() -> int:
                    "1 open / 1 close -> re-closed",
                    "harvest convergence analytics", "solved: 6",
                    "max_iter: 1", "wasted-iteration attribution",
-                   "lane 7"):
+                   "lane 7",
+                   # The SLO/alert timeline: transitions interleaved
+                   # with the breaker cycle + anomaly activity.
+                   "slo / alert timeline",
+                   "availability/fast -> firing",
+                   "availability/fast -> resolved",
+                   "anomaly    32x8 -> firing",
+                   "alerts: 1 fired / 1 resolved"):
         assert needle in text, f"selftest: {needle!r} missing from report"
     print(text)
     print("\nobs_report selftest: ok")

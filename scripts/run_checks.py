@@ -6,10 +6,12 @@ Usage:
 
 Defaults to scanning ``porqua_tpu/`` — every package subtree,
 including the observability stack ``porqua_tpu/obs/`` (the telemetry
-warehouse ``obs/harvest.py`` and stage profiler ``obs/profile.py``
-among it), the compaction driver ``porqua_tpu/compaction.py``, the
-continuous batcher ``porqua_tpu/serve/continuous.py``, and the
-resilience plane ``porqua_tpu/resilience/`` (all of which must scan
+warehouse ``obs/harvest.py``, stage profiler ``obs/profile.py``, and
+the live operational plane ``obs/slo.py`` / ``obs/flight.py`` /
+``obs/anomaly.py`` among it), the compaction driver
+``porqua_tpu/compaction.py``, the continuous batcher
+``porqua_tpu/serve/continuous.py``, and the resilience plane
+``porqua_tpu/resilience/`` (all of which must scan
 clean with zero suppressions, same bar as the solver) — with every AST rule
 (GC001-GC010; GC007 enforces the ``if faults.enabled():`` guard on
 every fault-injection seam; GC008-GC010 are the concurrency plane —
@@ -17,7 +19,7 @@ shared state inferred from the thread-root reachability graph, static
 lock-order deadlock detection, and blocking-calls-under-a-lock — whose
 runtime half is the ``PORQUA_TSAN=1`` lock-order sanitizer exercised
 by ``scripts/tsan_smoke.py``) plus the trace-time jaxpr contracts
-(GC101-GC105) against the real batch entry points on the XLA-CPU
+(GC101-GC106) against the real batch entry points on the XLA-CPU
 backend: default solver params, the convergence-ring telemetry
 variant (``SolverParams(ring_size>0)``), the compaction
 step-and-repack program (dense + factored — the machine-checked proof
@@ -25,11 +27,14 @@ the repack introduces no host syncs/transfers), the
 continuous-batching admit/step/finalize triple, the GC104
 fault-injector jaxpr-identity contract (solve/serve programs traced
 with a live injector must be string-identical to the bare traces —
-the "bit-identical when disabled" proof), and the GC105
+the "bit-identical when disabled" proof), the GC105
 telemetry-identity contract (the same identity bar with a live
 StageProfiler stage + HarvestSink — the harvest/profiling plane adds
-zero callbacks/transfers to any jitted entry). Exit status: 0 clean,
-1 findings, 2 internal/usage error.
+zero callbacks/transfers to any jitted entry), and the GC106
+observability-identity contract (the live SLO engine / flight
+recorder / anomaly detector, exercised through a firing alert and an
+incident dump, leave the solve/serve/compaction jaxprs string-
+identical). Exit status: 0 clean, 1 findings, 2 internal/usage error.
 
 Options:
     --format {text,json}   output format (default text)
@@ -102,7 +107,7 @@ def main(argv=None) -> int:
 
     if not args.no_contracts and (
             rules is None or rules & {"GC101", "GC102", "GC103", "GC104",
-                                      "GC105"}):
+                                      "GC105", "GC106"}):
         try:
             import jax
 

@@ -46,6 +46,30 @@ else
     fail=1
 fi
 
+# incident_report: the flight-recorder bundle renderer (recorder ->
+# trigger through a real event-bus listener -> gz round-trip ->
+# render, no JAX backend) must keep producing post-mortem reports.
+if out=$(timeout 120 python scripts/incident_report.py --selftest 2>&1); then
+    echo "OK   incident_report --selftest: $(echo "$out" | tail -1)"
+else
+    echo "FAIL incident_report --selftest:"
+    echo "$out"
+    fail=1
+fi
+
+# SLO/flight smoke: a real loadgen window with one injected
+# device_lost — the breaker trip must land exactly one parseable
+# incident bundle (trigger breaker_open) and the SLO engine must
+# report through the run (README "SLOs, alerting & incident
+# response").
+if out=$(timeout 600 env JAX_PLATFORMS=cpu python scripts/slo_smoke.py 2>&1); then
+    echo "OK   slo_smoke: $(echo "$out" | tail -1)"
+else
+    echo "FAIL slo_smoke:"
+    echo "$out"
+    fail=1
+fi
+
 # bench_gate: the BENCH-artifact regression differ (synthetic baseline
 # vs passing AND regressed payloads, plus the committed BENCH_r05
 # self-gate) — every future PR's perf claim is checked by this tool,

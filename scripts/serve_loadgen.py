@@ -23,6 +23,11 @@ Examples::
     python scripts/serve_loadgen.py --chaos device_lost \\
         --events-out chaos.jsonl   # one fault scenario under load;
                                    # the full matrix: scripts/chaos_suite.py
+    python scripts/serve_loadgen.py --slo --flight-out /tmp/incidents \\
+        --anomaly-baseline harvest.jsonl.gz  # live SLO engine + flight
+                                   # recorder + convergence anomaly
+                                   # detection (scripts/incident_report.py
+                                   # renders the bundles)
 
 Prints one JSON report line on stdout (diagnostics on stderr), in the
 same one-line-artifact style as ``bench.py``.
@@ -71,6 +76,29 @@ def main() -> int:
                          "(.gz gzips; aggregate with "
                          "scripts/harvest_report.py; pair with --rings "
                          "to persist residual trajectories)")
+    ap.add_argument("--slo", action="store_true",
+                    help="run the live SLO engine (availability, "
+                         "latency, zero-wrong-answers) with multi-"
+                         "window burn-rate alerting over the measured "
+                         "window; the report gains per-SLO compliance "
+                         "+ alert states (see README 'SLOs, alerting "
+                         "& incident response')")
+    ap.add_argument("--slo-latency-target", type=float, default=0.25,
+                    metavar="S",
+                    help="latency-SLO target in seconds (align with a "
+                         "histogram bucket edge; default 0.25)")
+    ap.add_argument("--flight-out", default=None, metavar="DIR",
+                    help="arm the incident flight recorder: any "
+                         "trigger (breaker open, retry giveup, firing "
+                         "SLO alert, ...) dumps one self-contained "
+                         "incident-*.json.gz bundle into DIR (render "
+                         "with scripts/incident_report.py)")
+    ap.add_argument("--anomaly-baseline", default=None, metavar="PATH",
+                    help="harvest dataset (JSONL/.gz, e.g. a "
+                         "--harvest-out artifact) to calibrate online "
+                         "convergence anomaly detection against; "
+                         "convergence_anomaly events feed the flight "
+                         "recorder")
     ap.add_argument("--rings", type=int, default=0, metavar="K",
                     help="compile with K-slot on-device convergence "
                          "rings and emit ring events for a sample of "
@@ -147,7 +175,10 @@ def main() -> int:
         harvest_out=args.harvest_out,
         continuous=args.continuous, segment_budget=args.segment_budget,
         retry=retry, chaos=args.chaos, chaos_seed=args.chaos_seed,
-        no_retry=args.no_retry)
+        no_retry=args.no_retry, slo=args.slo,
+        slo_latency_target_s=args.slo_latency_target,
+        flight_out=args.flight_out,
+        anomaly_baseline=args.anomaly_baseline)
     report["workload"] = args.workload
     print(json.dumps(report))
     # Under --chaos, errors are the scenario doing its job (failed

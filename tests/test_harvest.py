@@ -400,6 +400,38 @@ class TestExposition:
         h = m.histograms()["solve_latency_seconds"]
         assert h["sum"] == pytest.approx(20.0345)
 
+    def test_histogram_series_custom_ladder(self):
+        # latency_buckets is a deployment knob so SLO targets and
+        # histogram edges align (ISSUE 9 satellite): the cumulative
+        # series must follow the custom ladder exactly, default
+        # untouched elsewhere.
+        m = ServeMetrics(latency_buckets=(0.05, 0.25, 2.0))
+        for s in (0.01, 0.1, 0.1, 1.0, 30.0):
+            m.observe_latency(s)
+        text = prometheus_text(m.snapshot(), histograms=m.histograms())
+        assert 'porqua_serve_solve_latency_seconds_bucket{le="0.05"} 1' \
+            in text
+        assert 'porqua_serve_solve_latency_seconds_bucket{le="0.25"} 3' \
+            in text
+        assert 'porqua_serve_solve_latency_seconds_bucket{le="2"} 4' \
+            in text
+        assert 'porqua_serve_solve_latency_seconds_bucket{le="+Inf"} 5' \
+            in text
+        # The default ladder's edges must NOT appear.
+        assert 'le="0.001"' not in text
+
+    def test_extra_gauges_rendered(self):
+        m = ServeMetrics()
+        text = prometheus_text(
+            m.snapshot(),
+            extra_gauges={"slo_burn_rate_availability_fast_short": 2.5,
+                          "slo_alert_state_availability_fast": 2})
+        assert ("# TYPE porqua_serve_slo_burn_rate_availability_fast_"
+                "short gauge" in text)
+        assert "porqua_serve_slo_burn_rate_availability_fast_short 2.5" \
+            in text
+        assert "porqua_serve_slo_alert_state_availability_fast 2" in text
+
     def test_extra_counters_rendered(self):
         m = ServeMetrics()
         text = prometheus_text(
