@@ -64,6 +64,18 @@ reporting sustained throughput, p50/p99 latency, mean batch occupancy,
 and the recompile-after-warmup count (contract: 0). Emitted by both
 the TPU child and the CPU fallback.
 
+Device truth (README "Device-truth profiling"): the headline program
+is AOT-compiled (``jit().lower().compile()`` — the same program), so
+the artifact carries XLA's own ``cost_analysis``/``memory_analysis``
+as ``xla_cost`` (flops, bytes accessed, peak memory, HLO fingerprint,
+model-vs-compiler drift ratios) and the serving config a per-
+executable ``cost_summary`` — the fields ``scripts/bench_gate.py``'s
+cost-drift and peak-memory rules gate. ``--cost-out PATH`` exports the
+serving CostRecords (``scripts/roofline_report.py`` input);
+``--profile-dir DIR`` (optionally bounded by ``--profile-window S``,
+seconds — same semantics as serve_loadgen's knob) captures one
+steady-state dispatch in a programmatic ``jax.profiler`` trace.
+
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} (plus
 diagnostic fields) where value = device wall-clock seconds for the full
 252-date backtest and vs_baseline = CPU-baseline-seconds /
@@ -349,7 +361,7 @@ def device_child(platform: str, n_dates: int) -> None:
     import jax.numpy as jnp
 
     from porqua_tpu.qp.solve import SolverParams
-    from porqua_tpu.tracking import tracking_step_jit
+    from porqua_tpu.tracking import tracking_step, tracking_step_jit
 
     dev = jax.devices()[0]
     log(f"device: {dev.platform} ({dev.device_kind}); "
@@ -415,11 +427,29 @@ def device_child(platform: str, n_dates: int) -> None:
                                      woodbury_refine=0, check_interval=35,
                                      scaling_mode="factored")
 
+    # AOT compile (jit().lower().compile()) instead of first-call jit:
+    # the SAME program, but the compiled handle exposes XLA's own
+    # cost_analysis()/memory_analysis() — the device-truth numbers the
+    # artifact carries and bench_gate gates (devprof.cost_record).
     t0 = time.perf_counter()
-    out = tracking_step_jit(Xs, ys, params)
+    compiled_step = jax.jit(
+        lambda X, y: tracking_step(X, y, params)).lower(Xs, ys).compile()
+    out = compiled_step(Xs, ys)
     np.asarray(out.tracking_error)
     compile_s = time.perf_counter() - t0
     log(f"compile+first run: {compile_s:.2f}s")
+
+    from porqua_tpu.obs.devprof import cost_record
+
+    xla_cost = cost_record(
+        compiled_step, entry="tracking_step", kind="bench",
+        bucket=f"{N_ASSETS}x1", slots=n_dates,
+        dtype=str(np.dtype(np.float32).str),
+        device=f"{dev.platform}:{dev.id}", compile_s=compile_s)
+    if xla_cost.get("flops"):
+        log(f"xla cost: {xla_cost['flops']:.3g} flops, "
+            f"{xla_cost.get('bytes_accessed') or 0:.3g} bytes accessed, "
+            f"peak {(xla_cost.get('peak_bytes') or 0) / 1e6:.1f} MB")
 
     # Measurement discipline (perturbed inputs, device_get completion,
     # first run discarded, median) — shared helper, see its docstring
@@ -427,7 +457,7 @@ def device_child(platform: str, n_dates: int) -> None:
     from porqua_tpu.profiling import measure_device, measure_steady_state
 
     dev_s, runs, out = measure_device(
-        lambda X: tracking_step_jit(X, ys, params), Xs)
+        lambda X: compiled_step(X, ys), Xs)
 
     # The tunnel between this host and the TPU adds ~70 ms of dispatch
     # + completion latency to EVERY call — a property of this
@@ -498,6 +528,19 @@ def device_child(platform: str, n_dates: int) -> None:
     # per-dispatch latency is transport, not device time.
     roofline = roofline_report(
         model, steady_s if steady_s > 0 else dev_s, str(dev.device_kind))
+    # Device truth next to the model: XLA-measured achieved rates over
+    # the same seconds, and the model-vs-compiler drift ratios — the
+    # cost-drift signal bench_gate gates (an executable whose measured
+    # flops/bytes move is a program change; an unchanged hlo_hash with
+    # moved seconds is a runtime change). One shared formula
+    # (devprof.measured_rates) with the serving profiles, so the
+    # headline's drift ratios and theirs cannot diverge.
+    from porqua_tpu.obs.devprof import measured_rates
+
+    xla_cost.update(measured_rates(
+        xla_cost, steady_s if steady_s > 0 else dev_s,
+        model_flops=model["flops_total"],
+        model_bytes=model["bytes_total"]))
     log("roofline: " + ", ".join(
         f"{k}={v:.3g}" if isinstance(v, float) else f"{k}={v}"
         for k, v in roofline.items()
@@ -526,7 +569,39 @@ def device_child(platform: str, n_dates: int) -> None:
         "check_interval": params.check_interval,
         "roofline": {k: v for k, v in roofline.items()
                      if not isinstance(v, dict)},
+        # Device truth per entry: what XLA says the headline program
+        # costs (flops / bytes accessed / peak memory / HLO hash) —
+        # bench_gate's cost-drift and peak-memory rules gate these.
+        "xla_cost": {k: v for k, v in xla_cost.items()
+                     if k not in ("v", "t")},
     })
+
+    # --profile-window/--profile-dir: one steady-state dispatch
+    # captured in a bounded programmatic jax.profiler trace (the
+    # device-trace evidence the roofline verdict links;
+    # transport-heavy tunnels make this the only honest view of where
+    # device time goes). Same ProfileWindow (and the same
+    # seconds-means-bound semantics) as serve_loadgen's knob; the
+    # timer caps a black-holing dispatch.
+    profile_dir = os.environ.get("PORQUA_BENCH_PROFILE_DIR") or None
+    window_env = os.environ.get("PORQUA_BENCH_PROFILE_WINDOW") or None
+    if profile_dir or window_env:
+        from porqua_tpu.obs.devprof import ProfileWindow
+
+        window = ProfileWindow(
+            profile_dir or "porqua_profile_trace",
+            window_s=float(window_env) if window_env else None)
+        if window.start():
+            try:
+                np.asarray(compiled_step(Xs, ys).tracking_error)
+            finally:
+                window.stop()
+        if window.error:
+            log(f"profile window failed: {window.error}")
+        else:
+            _emit({"part": "profile_trace",
+                   "profile_trace_dir": window.logdir})
+            log(f"profiler trace written under {window.logdir}")
 
     if dev.platform != "tpu":
         # Round-4 (verdict item 6): the fallback artifact must still
@@ -884,9 +959,12 @@ def _secondary_config_serving(child_left, n_requests=1024, n_assets=24,
     # --harvest-out: append one telemetry-warehouse SolveRecord per
     # resolved request (scripts/harvest_report.py aggregates).
     harvest_out = os.environ.get("PORQUA_BENCH_HARVEST_OUT") or None
+    # --cost-out: export the serving cache's CostRecords (XLA-measured
+    # flops/bytes/peak memory per compiled executable).
+    cost_out = os.environ.get("PORQUA_BENCH_COST_OUT") or None
     report = run_loadgen(requests, max_batch=max_batch,
                          inflight=4 * max_batch, trace_out=trace_out,
-                         harvest_out=harvest_out)
+                         harvest_out=harvest_out, cost_out=cost_out)
     _emit({
         "part": "config_serving",
         "n_requests": n_requests,
@@ -914,6 +992,13 @@ def _secondary_config_serving(child_left, n_requests=1024, n_assets=24,
             "harvest_write_failures":
                 report.get("harvest_write_failures")}
            if harvest_out else {}),
+        # Device truth per serving executable: the cache's harvested
+        # XLA cost/memory maxima (full records via --cost-out).
+        **({"cost_summary": report["cost_summary"]}
+           if report.get("cost_summary") else {}),
+        **({"cost_out": report.get("cost_out"),
+            "cost_records": report.get("cost_records")}
+           if cost_out else {}),
         "note": "closed-loop serve_loadgen stream through "
                 "porqua_tpu.serve.SolveService (dynamic micro-batching "
                 "+ AOT executable cache); recompiles_after_warmup==0 "
@@ -1134,8 +1219,10 @@ def run_device_benchmark(state):
                           None)
             if main_p is not None:
                 state["device"] = main_p
-                state["secondary"] = [p for p in payloads
-                                      if p.get("part", "").startswith("config")]
+                state["secondary"] = [
+                    p for p in payloads
+                    if p.get("part", "").startswith(
+                        ("config", "profile_trace"))]
                 if err:
                     # Timeout during secondary metrics: headline intact.
                     errors.append(err)
@@ -1160,8 +1247,10 @@ def run_device_benchmark(state):
     if state["device"] is None:
         if main_p is not None:
             state["device"] = main_p
-            state["secondary"] = [p for p in payloads
-                                  if p.get("part", "").startswith("config")]
+            state["secondary"] = [
+                p for p in payloads
+                if p.get("part", "").startswith(
+                    ("config", "profile_trace"))]
             size = ("full size"
                     if main_p.get("n_dates", 0) >= N_DATES
                     else f"reduced size ({main_p.get('n_dates')} dates)")
@@ -1286,6 +1375,12 @@ def _assemble(state) -> dict:
                 k: (round(v, 5) if isinstance(v, float) else v)
                 for k, v in result["roofline"].items()
             }
+        if result.get("xla_cost"):
+            # Device truth in the top-level artifact: bench_gate's
+            # cost-drift / peak-memory rules read xla_cost.* — a field
+            # the artifact drops is a field the gate can never protect
+            # (same posture as the iteration distribution above).
+            payload["xla_cost"] = result["xla_cost"]
     elif base is not None:
         # Even the CPU child failed — report the baseline alone rather
         # than dying; value reflects the serial CPU path (speedup 1.0).
@@ -1338,12 +1433,38 @@ def _consume_path_flag(flag: str, env_var: str) -> None:
     del sys.argv[i:i + 2]
 
 
+def _consume_value_flag(flag: str, env_var: str) -> None:
+    """Pop ``<flag> VALUE`` from argv into ``env_var`` verbatim (no
+    path resolution) — for non-path values like the profiler window
+    seconds."""
+    if flag not in sys.argv:
+        return
+    i = sys.argv.index(flag)
+    if i + 1 >= len(sys.argv):
+        print(f"bench.py: {flag} requires a value", file=sys.stderr)
+        sys.exit(2)
+    os.environ[env_var] = sys.argv[i + 1]
+    del sys.argv[i:i + 2]
+
+
 def main():
     # --trace-out PATH: the serving config records request spans and
     # writes a Perfetto-loadable Chrome trace there. --harvest-out
     # PATH: it appends its telemetry-warehouse dataset there.
+    # --profile-dir DIR [--profile-window S]: the device child
+    # captures one bounded programmatic jax.profiler trace of a
+    # steady-state dispatch there (the device-truth complement of the
+    # analytic roofline); the window seconds cap a hanging dispatch —
+    # SAME flag semantics as serve_loadgen.py (--profile-window is
+    # always seconds, --profile-dir always the trace directory).
+    # --cost-out PATH: the serving config exports its CostRecords
+    # (XLA cost/memory analysis per compiled executable) as JSONL —
+    # the scripts/roofline_report.py input.
     _consume_path_flag("--trace-out", "PORQUA_BENCH_TRACE_OUT")
     _consume_path_flag("--harvest-out", "PORQUA_BENCH_HARVEST_OUT")
+    _consume_path_flag("--profile-dir", "PORQUA_BENCH_PROFILE_DIR")
+    _consume_value_flag("--profile-window", "PORQUA_BENCH_PROFILE_WINDOW")
+    _consume_path_flag("--cost-out", "PORQUA_BENCH_COST_OUT")
     if len(sys.argv) >= 3 and sys.argv[1] == "--device-child":
         device_child(sys.argv[2], int(sys.argv[3])
                      if len(sys.argv) > 3 else N_DATES)

@@ -41,6 +41,7 @@ except ImportError:  # pragma: no cover - version dependent
 
 __all__ = [
     "check_closed_jaxpr",
+    "check_devprof_identity",
     "check_entry_points",
     "check_observability_identity",
     "check_resilience_identity",
@@ -467,6 +468,73 @@ def check_observability_identity(dtype=np.float32) -> List[Finding]:
     return findings
 
 
+def check_devprof_identity(dtype=np.float32) -> List[Finding]:
+    """GC107: the device-truth cost plane must be invisible to XLA.
+
+    The cost warehouse (:mod:`porqua_tpu.obs.devprof`) promises it is
+    strictly post-compile host bookkeeping: ``cost_analysis()`` /
+    ``memory_analysis()`` / ``as_text()`` are read off an
+    already-compiled executable, the CostRecord is a dict, and the
+    measured profile (:func:`porqua_tpu.obs.profile.qp_solve_profile`
+    with ``cost=``) is float arithmetic — zero callbacks, zero
+    transfers, zero program edits on any jitted entry. This check
+    machine-verifies the enabled half of "disabled == bit-identical"
+    (the runtime half is pinned by ``tests/test_devprof.py``): the
+    solve/serve entry points are traced bare, then the plane is
+    exercised FOR REAL — a probe program is AOT-compiled, its cost and
+    memory analyses harvested into a CostRecord, the record emitted
+    through a live :class:`CostLog`, and a measured (``cost_source:
+    "xla"``) profile computed from it — and the entry points are
+    re-traced. The jaxprs must be string-identical, and the probe must
+    actually have harvested (an empty record would prove nothing).
+    """
+    import jax.numpy as jnp
+
+    from porqua_tpu.obs.devprof import CostLog, cost_record
+    from porqua_tpu.obs.profile import qp_solve_profile
+
+    def trace_all():
+        return [("solve_batch", str(solve_batch_jaxpr(dtype=dtype))),
+                ("serve_entry", str(serve_entry_jaxpr(dtype=dtype)))]
+
+    findings: List[Finding] = []
+    baseline = trace_all()
+
+    # Drive the plane hot: a real AOT compile -> harvest -> log ->
+    # measured profile. The probe program is tiny (one 8x8 matmul) so
+    # the contract stays CI-cheap; the harvesting path it exercises is
+    # exactly the one ExecutableCache._build runs per executable.
+    probe = jax.jit(lambda a: a @ a).lower(
+        jax.ShapeDtypeStruct((8, 8), jnp.dtype(dtype))).compile()
+    log = CostLog(path=None)
+    rec = cost_record(probe, entry="gc107-probe", kind="contract",
+                      bucket="8x8", slots=1,
+                      dtype=np.dtype(dtype).str, compile_s=0.0)
+    log.emit(rec)
+    prof = qp_solve_profile(8, 8, 10.0, 0.01, cost=rec)
+    live = trace_all()
+
+    if rec.get("flops") is None and rec.get("bytes_accessed") is None:
+        findings.append(Finding(
+            "GC107", "<jaxpr:devprof_identity>", 0, 0,
+            "the probe executable yielded no cost analysis on this "
+            "backend — the identity check exercised nothing"))
+    if log.records != 1 or prof.get("cost_source") != "xla":
+        findings.append(Finding(
+            "GC107", "<jaxpr:devprof_identity>", 0, 0,
+            "the cost-plane probe did not run end to end (no record "
+            "logged or the profile never switched to XLA numerators) "
+            "— the identity check proved nothing"))
+    for (label, base), (_, lv) in zip(baseline, live):
+        if base != lv:
+            findings.append(Finding(
+                "GC107", f"<jaxpr:{label}>", 0, 0,
+                "traced program differs with the device-truth cost "
+                "plane active: cost harvesting is no longer invisible "
+                "to XLA (disabled-bit-identity contract broken)"))
+    return findings
+
+
 def run_batch_jaxpr(bs, params=None, dtype=np.float32) -> ClosedJaxpr:
     """Trace ``run_batch``'s device core against a *real*
     ``BacktestService``: the host pass (``build_problems``) runs for
@@ -554,4 +622,10 @@ def check_entry_points(dtype=np.float32,
     # leave the traced solve/serve/compaction programs string-
     # identical (the whole plane is counters-and-rings host code).
     findings += check_observability_identity(dtype=dtype)
+    # GC107: and for the device-truth cost plane — harvesting a real
+    # executable's cost/memory analysis into a CostRecord, logging it,
+    # and computing a measured profile from it must leave the traced
+    # solve/serve programs string-identical (the plane reads compiled
+    # objects, never traced ones).
+    findings += check_devprof_identity(dtype=dtype)
     return findings

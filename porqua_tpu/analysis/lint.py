@@ -97,6 +97,7 @@ RULE_DOCS = {
     "GC104": "fault injection perturbs a traced program",
     "GC105": "telemetry (harvest/profiling) perturbs a traced program",
     "GC106": "live plane (SLO/flight/anomaly) perturbs a traced program",
+    "GC107": "device-truth cost plane perturbs a traced program",
 }
 
 _CONTRACTIONS = {"dot", "einsum", "matmul", "tensordot", "inner", "vdot"}

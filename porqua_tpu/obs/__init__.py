@@ -28,6 +28,16 @@ debounced, self-contained evidence bundles on triggers), and
 anomaly detection) — wired through ``SolveService(slo=..., flight=...,
 anomaly=...)`` and machine-checked invisible to XLA by contract GC106.
 
+The **device-truth profiling plane** (README "Device-truth
+profiling") grounds the perf claims in the compiler's own numbers:
+:mod:`porqua_tpu.obs.devprof` harvests every AOT executable's XLA
+``cost_analysis``/``memory_analysis`` into CostRecords (``CostLog``),
+``qp_solve_profile`` switches its MFU/bandwidth numerators to those
+measured figures where available, and ``roofline_verdict`` /
+``scripts/roofline_report.py`` rank executables by measured bytes
+into the fusion-candidate verdict — contract GC107 pins the plane
+invisible to XLA.
+
 :class:`Observability` bundles one span recorder and one event bus;
 pass it to ``SolveService(obs=...)`` and every layer (batcher,
 executable cache, device health) records through it. The package is
@@ -36,6 +46,13 @@ in it runs on the request hot path beyond lock-bounded appends.
 """
 
 from porqua_tpu.obs.anomaly import AnomalyDetector
+from porqua_tpu.obs.devprof import (
+    CostLog,
+    ProfileWindow,
+    cost_record,
+    load_cost_records,
+    roofline_verdict,
+)
 from porqua_tpu.obs.events import EventBus, load_jsonl
 from porqua_tpu.obs.exposition import ObsHTTPServer, prometheus_text
 from porqua_tpu.obs.flight import FlightRecorder, load_bundle
@@ -72,25 +89,30 @@ class Observability:
 __all__ = [
     "AnomalyDetector",
     "BurnRateRule",
+    "CostLog",
     "EventBus",
     "FlightRecorder",
     "HarvestSink",
     "Observability",
     "ObsHTTPServer",
+    "ProfileWindow",
     "SLO",
     "SLOEngine",
     "Span",
     "SpanRecorder",
     "StageProfiler",
+    "cost_record",
     "default_slos",
     "harvest_solution",
     "load_bundle",
+    "load_cost_records",
     "load_harvest",
     "load_jsonl",
     "prometheus_text",
     "qp_solve_profile",
     "render_report",
     "ring_history",
+    "roofline_verdict",
     "solution_ring_history",
     "solve_record",
 ]

@@ -1,0 +1,546 @@
+"""Device-truth profiling: XLA cost/memory warehouse + measured roofline.
+
+Every MFU / bandwidth figure the stack reported before this module was
+an *analytic estimate* — ``profiling.admm_flop_model`` multiplied by a
+wall-clock. The compiler knows better: every AOT executable carries
+``compiled.cost_analysis()`` (XLA-counted flops and bytes accessed)
+and ``compiled.memory_analysis()`` (argument / output / temp / peak
+buffer bytes). This module harvests that device truth once per compile
+and makes it a first-class artifact:
+
+* :func:`cost_record` — ONE schema (``COST_SCHEMA_VERSION``) for what
+  XLA says one compiled executable costs: flops, bytes accessed,
+  argument/output/temp/peak memory, generated-code size, compile
+  seconds, and an HLO-module fingerprint — keyed by (kind, entry,
+  bucket, slots, dtype, device). Harvesting is version-tolerant and
+  NEVER raises: a backend that refuses an analysis yields ``None``
+  fields, not a failed compile.
+* :class:`CostLog` — the append-only JSONL(.gz) CostRecord warehouse,
+  mirror of :class:`~porqua_tpu.obs.harvest.HarvestSink` (thread-safe,
+  ``emit`` never raises, dead disks degrade to counters). The serve
+  stack's :class:`~porqua_tpu.serve.bucketing.ExecutableCache` emits
+  one record per executable it compiles.
+* :func:`roofline_verdict` — the reader half: join CostRecords with
+  measured stage seconds, rank executables by *measured* bytes, and
+  emit the top fusion candidates as a machine-readable verdict — the
+  evidence artifact the ROADMAP fusion item consumes
+  (``scripts/roofline_report.py`` is the CLI).
+* :class:`ProfileWindow` — a bounded programmatic ``jax.profiler``
+  trace (started mid-steady-state, stopped by a timer), the
+  ``--profile-window`` knob on ``serve_loadgen.py`` / ``bench.py``.
+
+Everything here is host post-processing of objects the compile path
+already produced: contract GC107 (:func:`porqua_tpu.analysis.
+contracts.check_devprof_identity`) machine-checks that a live cost
+plane — records harvested, log emitting, measured profile computed —
+changes no traced program, and the disabled mode is pinned
+bit-identical by ``tests/test_devprof.py``.
+"""
+
+from __future__ import annotations
+
+import gzip
+import hashlib
+import json
+import re
+import threading
+import time
+from typing import Any, Dict, Iterable, List, Optional
+
+from porqua_tpu.analysis import tsan
+
+__all__ = [
+    "COST_SCHEMA_VERSION",
+    "CostLog",
+    "ProfileWindow",
+    "cost_record",
+    "executable_cost",
+    "executable_memory",
+    "hlo_fingerprint",
+    "load_cost_records",
+    "measured_rates",
+    "roofline_verdict",
+    "write_cost_records",
+]
+
+#: Bump when a field changes meaning; additive fields don't need it.
+COST_SCHEMA_VERSION = 1
+
+
+def executable_cost(compiled) -> Dict[str, Optional[float]]:
+    """XLA-counted flops / bytes of one compiled executable.
+
+    ``cost_analysis()`` returns a dict on current jax and a one-dict
+    list on older versions; either way the totals live under
+    ``"flops"`` and ``"bytes accessed"``. Returns ``None`` values when
+    the backend refuses the analysis (some plugin backends do) — the
+    caller records the refusal instead of failing the compile.
+    """
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        flops = ca.get("flops")
+        bytes_accessed = ca.get("bytes accessed")
+        return {
+            "flops": None if flops is None else float(flops),
+            "bytes_accessed": (None if bytes_accessed is None
+                               else float(bytes_accessed)),
+        }
+    except Exception:  # noqa: BLE001 - analysis must never fail a compile
+        return {"flops": None, "bytes_accessed": None}
+
+
+def executable_memory(compiled) -> Dict[str, Optional[float]]:
+    """``memory_analysis()`` flattened: argument / output / temp /
+    alias / generated-code bytes plus the derived ``peak_bytes``
+    (argument + output + temp − alias; the backend's own
+    ``peak_memory_in_bytes`` is preferred where the jaxlib exposes
+    it). ``None`` values when the backend refuses."""
+    try:
+        ma = compiled.memory_analysis()
+        if ma is None:
+            return {"peak_bytes": None}
+        get = (ma.get if isinstance(ma, dict)
+               else lambda k, d=None: getattr(ma, k, d))
+        out: Dict[str, Optional[float]] = {}
+        for field in ("argument_size_in_bytes", "output_size_in_bytes",
+                      "temp_size_in_bytes", "alias_size_in_bytes",
+                      "generated_code_size_in_bytes"):
+            v = get(field)
+            short = field.replace("_size_in_bytes", "_bytes")
+            out[short] = None if v is None else float(v)
+        peak = get("peak_memory_in_bytes")
+        if peak is None:
+            parts = [out.get("argument_bytes"), out.get("output_bytes"),
+                     out.get("temp_bytes")]
+            if any(p is not None for p in parts):
+                peak = (sum(p or 0.0 for p in parts)
+                        - (out.get("alias_bytes") or 0.0))
+        out["peak_bytes"] = None if peak is None else float(peak)
+        return out
+    except Exception:  # noqa: BLE001 - analysis must never fail a compile
+        return {"peak_bytes": None}
+
+
+#: Non-semantic HLO decoration stripped before fingerprinting:
+#: ``metadata={op_name=... source_file=... source_line=N}`` clauses
+#: change with source position (two compiles of the same program from
+#: different call sites would otherwise hash differently).
+_HLO_METADATA_RE = re.compile(r", metadata=\{[^{}]*\}")
+
+
+def hlo_fingerprint(compiled) -> Optional[str]:
+    """A short blake2b digest of the optimized HLO module text — the
+    identity that says whether two rounds compiled the *same program*
+    (a cost drift with an unchanged fingerprint is an XLA/runtime
+    change; with a changed one, a program change). Source-location
+    metadata is stripped first: it is call-site decoration, not
+    program."""
+    try:
+        text = compiled.as_text()
+        if not text:
+            return None
+        text = _HLO_METADATA_RE.sub("", text)
+        return hashlib.blake2b(text.encode(), digest_size=8).hexdigest()
+    except Exception:  # noqa: BLE001 - fingerprinting is best-effort
+        return None
+
+
+def cost_record(compiled,
+                entry: str,
+                kind: str,
+                bucket: Optional[str] = None,
+                slots: Optional[int] = None,
+                dtype: Optional[str] = None,
+                device: Optional[str] = None,
+                compile_s: Optional[float] = None,
+                **extra) -> Dict[str, Any]:
+    """Build one CostRecord dict from a compiled executable (the
+    schema's single constructor — every harvester goes through here so
+    fields cannot drift apart). Never raises: analysis refusals land
+    as ``None`` fields."""
+    rec: Dict[str, Any] = {
+        "v": COST_SCHEMA_VERSION,
+        "t": time.time(),
+        "kind": str(kind),
+        "entry": str(entry),
+    }
+    if bucket is not None:
+        rec["bucket"] = str(bucket)
+    if slots is not None:
+        rec["slots"] = int(slots)
+    if dtype is not None:
+        rec["dtype"] = str(dtype)
+    if device is not None:
+        rec["device"] = str(device)
+    if compile_s is not None:
+        rec["compile_s"] = float(compile_s)
+    rec.update(executable_cost(compiled))
+    rec.update(executable_memory(compiled))
+    rec["hlo_hash"] = hlo_fingerprint(compiled)
+    rec.update(extra)
+    return rec
+
+
+class CostLog:
+    """Thread-safe append-only CostRecord warehouse (JSONL, ``.gz``
+    transparently gzipped; ``path=None`` keeps a bounded in-memory
+    buffer). ``emit`` never raises — it runs on the compile path, and
+    a dead disk degrades to ``write_failures``, not failed compiles.
+    Same posture as :class:`~porqua_tpu.obs.harvest.HarvestSink`,
+    kept separate because cost records are per-*compile* (a handful
+    per process), not per-solve. ``append=False`` truncates an
+    existing file (one-shot exports; the default appends, the
+    long-lived-warehouse contract)."""
+
+    def __init__(self, path: Optional[str] = None,
+                 buffer_capacity: int = 4096,
+                 append: bool = True) -> None:
+        self.path = path
+        self._lock = tsan.lock("CostLog")
+        self._records = 0                 # guarded-by: self._lock
+        self._write_failures = 0          # guarded-by: self._lock
+        self._buffer_capacity = int(buffer_capacity)
+        self._buffer: List[Dict[str, Any]] = []  # guarded-by: self._lock
+        self._sink = None                 # guarded-by: self._lock
+        if path is not None:
+            mode = "at" if append else "wt"
+            try:
+                self._sink = (gzip.open(path, mode)
+                              if str(path).endswith(".gz")
+                              else open(path, mode[0]))
+            except OSError:
+                self._write_failures += 1
+
+    def emit(self, record: Dict[str, Any]) -> None:
+        """Append one record; never raises (see class docstring)."""
+        line = (json.dumps(record, default=str)
+                if self._sink is not None else None)
+        with self._lock:
+            self._records += 1
+            if self._sink is not None and line is not None:
+                try:
+                    self._sink.write(line + "\n")
+                except (OSError, ValueError):
+                    self._write_failures += 1
+                    self._sink = None  # dead sink: keep compiling
+            elif len(self._buffer) < self._buffer_capacity:
+                self._buffer.append(record)
+
+    # -- readers -----------------------------------------------------
+
+    @property
+    def records(self) -> int:
+        with self._lock:
+            return self._records
+
+    @property
+    def write_failures(self) -> int:
+        with self._lock:
+            return self._write_failures
+
+    def buffered(self) -> List[Dict[str, Any]]:
+        """In-memory records (``path=None`` logs only)."""
+        with self._lock:
+            return list(self._buffer)
+
+    def counters(self) -> Dict[str, int]:
+        with self._lock:
+            return {"cost_records": self._records,
+                    "cost_write_failures": self._write_failures}
+
+    def flush(self) -> None:
+        with self._lock:
+            if self._sink is not None:
+                try:
+                    self._sink.flush()
+                except OSError:
+                    self._write_failures += 1
+                    self._sink = None
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sink is not None:
+                try:
+                    self._sink.close()
+                except OSError:
+                    self._write_failures += 1
+                self._sink = None
+
+    def __enter__(self) -> "CostLog":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def load_cost_records(path: str) -> List[Dict[str, Any]]:
+    """Read a CostRecord dataset back (JSONL, ``.gz`` transparently)."""
+    opener = gzip.open if str(path).endswith(".gz") else open
+    out: List[Dict[str, Any]] = []
+    with opener(path, "rt") as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def write_cost_records(path: str,
+                       records: Iterable[Dict[str, Any]]) -> int:
+    """Dump an iterable of CostRecords as JSONL(.gz); returns the
+    count. A one-shot export (``run_loadgen(cost_out=...)``), so the
+    file is TRUNCATED: re-running a loadgen with the same ``--cost-out``
+    must describe that run, not accumulate stale executables from the
+    last one into the roofline verdict."""
+    n = 0
+    with CostLog(path, append=False) as log:
+        for rec in records:
+            log.emit(rec)
+            n += 1
+    return n
+
+
+def measured_rates(record: Dict[str, Any],
+                   seconds: Optional[float] = None,
+                   model_flops: Optional[float] = None,
+                   model_bytes: Optional[float] = None
+                   ) -> Dict[str, float]:
+    """Achieved rates + model-drift ratios for one CostRecord — the
+    ONE home of the measured-roofline arithmetic, shared by
+    ``bench.py``'s ``xla_cost`` block and
+    :func:`porqua_tpu.obs.profile.qp_solve_profile` so the two cannot
+    drift apart. ``seconds`` (measured wall of the program) enables
+    ``achieved_tflops``/``achieved_hbm_gbps``; ``model_flops``/
+    ``model_bytes`` (the analytic figures) enable the
+    ``*_model_ratio`` drift metrics. Fields appear only when both
+    sides of their division exist."""
+    out: Dict[str, float] = {}
+    flops = record.get("flops")
+    bytes_acc = record.get("bytes_accessed")
+    if seconds and seconds > 0:
+        if flops:
+            out["achieved_tflops"] = flops / seconds / 1e12
+        if bytes_acc:
+            out["achieved_hbm_gbps"] = bytes_acc / seconds / 1e9
+    if flops and model_flops is not None:
+        out["flops_model_ratio"] = float(model_flops) / flops
+    if bytes_acc and model_bytes is not None:
+        out["bytes_model_ratio"] = float(model_bytes) / bytes_acc
+    return out
+
+
+# ---------------------------------------------------------------------------
+# bounded programmatic profiler window
+# ---------------------------------------------------------------------------
+
+class ProfileWindow:
+    """A bounded ``jax.profiler`` trace: :meth:`start` opens the trace
+    and arms a daemon timer that stops it after ``window_s`` seconds;
+    :meth:`stop` is idempotent (the run's teardown calls it
+    unconditionally — whichever of the timer and the teardown fires
+    second is a no-op). Failures never propagate: profiling a run must
+    not fail it (``error`` carries the first failure for the report).
+    """
+
+    def __init__(self, logdir: str, window_s: Optional[float] = None) -> None:
+        self.logdir = str(logdir)
+        self.window_s = None if window_s is None else float(window_s)
+        self._lock = tsan.lock("ProfileWindow")
+        self._state = "idle"              # guarded-by: self._lock
+        self._timer: Optional[threading.Timer] = None  # guarded-by: self._lock
+        self._error: Optional[str] = None  # guarded-by: self._lock
+
+    def _note_error(self, exc: BaseException) -> None:
+        with self._lock:
+            if self._error is None:
+                self._error = f"{type(exc).__name__}: {exc}"
+
+    def start(self) -> bool:
+        with self._lock:
+            if self._state != "idle":
+                return False
+            self._state = "tracing"
+        try:
+            import jax
+
+            jax.profiler.start_trace(self.logdir)
+        except Exception as exc:  # noqa: BLE001 - best-effort capture
+            self._note_error(exc)
+            with self._lock:
+                self._state = "failed"
+            return False
+        if self.window_s is not None:
+            t = threading.Timer(self.window_s, self.stop)
+            t.daemon = True
+            with self._lock:
+                self._timer = t
+            t.start()
+        return True
+
+    def stop(self) -> bool:
+        with self._lock:
+            if self._state != "tracing":
+                return False
+            self._state = "stopped"
+            timer, self._timer = self._timer, None
+        if timer is not None:
+            timer.cancel()
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception as exc:  # noqa: BLE001 - best-effort capture
+            self._note_error(exc)
+            return False
+        return True
+
+    @property
+    def error(self) -> Optional[str]:
+        with self._lock:
+            return self._error
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+
+# ---------------------------------------------------------------------------
+# the roofline verdict (scripts/roofline_report.py renders it)
+# ---------------------------------------------------------------------------
+
+#: CostRecord ``entry`` -> the StageProfiler stage(s) whose measured
+#: seconds describe dispatches of that executable (the join key between
+#: the cost warehouse and a loadgen/bench run's ``profile_stages``).
+ENTRY_STAGES = {
+    "solve": ("serve/solve_batch",),
+    "admit": ("serve/admit",),
+    "step": ("serve/segment_step", "segment_step"),
+    "finalize": ("serve/finalize", "finalize"),
+    "init": ("init",),
+    "tracking_step": ("solve",),
+}
+
+
+def _identity(rec: Dict[str, Any]) -> tuple:
+    return (rec.get("kind"), rec.get("entry"), rec.get("bucket"),
+            rec.get("slots"), rec.get("dtype"), rec.get("device"))
+
+
+def roofline_verdict(records: Iterable[Dict[str, Any]],
+                     stage_seconds: Optional[Dict[str, float]] = None,
+                     top: int = 5,
+                     device_kind: str = "") -> Dict[str, Any]:
+    """Rank executables by XLA-measured bytes and emit fusion targets.
+
+    ``records`` is a CostRecord stream (append-only: the LATEST record
+    per (kind, entry, bucket, slots, dtype, device) identity wins);
+    ``stage_seconds`` is a run's measured per-stage host seconds
+    (loadgen/bench ``profile_stages``), joined per entry through
+    :data:`ENTRY_STAGES`. Each ranked row carries arithmetic intensity
+    (flops per byte accessed); with a known ``device_kind`` the row is
+    classified against the chip's ridge point (peak flops / peak
+    bandwidth — below it the executable cannot be compute-bound no
+    matter how well it schedules), otherwise intensity alone is
+    reported. The verdict's ``fusion_candidates`` are the ``top``
+    rows by measured bytes — the executables where fusing away
+    intermediate traffic buys the most — which is exactly the
+    machine-readable input the ROADMAP's Pallas-fusion item consumes.
+    """
+    from porqua_tpu.profiling import device_peaks
+
+    latest: Dict[tuple, Dict[str, Any]] = {}
+    total_in = 0
+    for rec in records:
+        total_in += 1
+        latest[_identity(rec)] = rec
+
+    peak_flops, peak_bw = device_peaks(device_kind)
+    ridge = (peak_flops / peak_bw) if peak_flops and peak_bw else None
+
+    rows: List[Dict[str, Any]] = []
+    for rec in latest.values():
+        flops = rec.get("flops")
+        bytes_acc = rec.get("bytes_accessed")
+        row: Dict[str, Any] = {
+            "kind": rec.get("kind"),
+            "entry": rec.get("entry"),
+            "bucket": rec.get("bucket"),
+            "slots": rec.get("slots"),
+            "device": rec.get("device"),
+            "flops": flops,
+            "bytes_accessed": bytes_acc,
+            "peak_bytes": rec.get("peak_bytes"),
+            "hlo_hash": rec.get("hlo_hash"),
+        }
+        if flops and bytes_acc:
+            row["arithmetic_intensity"] = flops / bytes_acc
+            if ridge is not None:
+                row["bound"] = ("memory"
+                                if row["arithmetic_intensity"] < ridge
+                                else "compute")
+        stages = {}
+        for stage in ENTRY_STAGES.get(str(rec.get("entry")), ()):
+            if stage_seconds and stage in stage_seconds:
+                stages[stage] = float(stage_seconds[stage])
+        if stages:
+            row["stage_seconds"] = stages
+            secs = sum(stages.values())
+            if bytes_acc and secs > 0:
+                # A floor, not a rate: one dispatch's bytes over the
+                # stage's TOTAL seconds (the stage covers every
+                # dispatch of the entry; without per-entry dispatch
+                # counts the honest derived figure is "at least").
+                row["min_achieved_gbps"] = bytes_acc / secs / 1e9
+        rows.append(row)
+
+    rows.sort(key=lambda r: (r.get("bytes_accessed") or 0.0),
+              reverse=True)
+    for i, row in enumerate(rows):
+        row["rank"] = i + 1
+
+    candidates = [r for r in rows if r.get("bytes_accessed")]
+    if ridge is not None:
+        mem_bound = [r for r in candidates if r.get("bound") == "memory"]
+        if mem_bound:
+            candidates = mem_bound
+    candidates = candidates[:max(int(top), 0)]
+
+    stages_ranked = []
+    if stage_seconds:
+        stages_ranked = sorted(
+            ({"stage": k, "seconds": float(v)}
+             for k, v in stage_seconds.items()),
+            key=lambda s: s["seconds"], reverse=True)
+
+    verdict: Dict[str, Any] = {
+        "v": COST_SCHEMA_VERSION,
+        "t": time.time(),
+        "records_in": total_in,
+        "executables": len(rows),
+        "device_kind": device_kind or None,
+        "ridge_flops_per_byte": ridge,
+        "ranked": rows,
+        "stages_ranked": stages_ranked,
+        "fusion_candidates": [
+            {"kind": r.get("kind"), "entry": r.get("entry"),
+             "bucket": r.get("bucket"), "slots": r.get("slots"),
+             "bytes_accessed": r.get("bytes_accessed"),
+             "arithmetic_intensity": r.get("arithmetic_intensity"),
+             "bound": r.get("bound"),
+             "reason": ("largest measured byte traffic"
+                        + ("" if r.get("bound") != "memory"
+                           else " and memory-bound at this chip's "
+                                "ridge point"))}
+            for r in candidates],
+    }
+    verdict["verdict"] = (
+        "no executables with measured bytes — harvest CostRecords first"
+        if not candidates else
+        f"top fusion target: {candidates[0].get('entry')} "
+        f"{candidates[0].get('bucket')} x{candidates[0].get('slots')} "
+        f"({(candidates[0].get('bytes_accessed') or 0) / 1e6:.1f} MB "
+        f"accessed per dispatch)")
+    return verdict

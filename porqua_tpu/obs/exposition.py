@@ -29,6 +29,14 @@ def _metric_name(prefix: str, key: str) -> str:
     return f"{prefix}_{_NAME_RE.sub('_', key)}"
 
 
+def _escape_label(value) -> str:
+    """Label-value escaping per the exposition format spec: an
+    unescaped ``"``/``\\``/newline in any label would invalidate the
+    WHOLE scrape, not just its line."""
+    return (str(value).replace("\\", r"\\").replace('"', r"\"")
+            .replace("\n", r"\n"))
+
+
 def _histogram_lines(name: str, hist: Dict[str, Any]) -> list:
     """Render one histogram as cumulative ``_bucket``/``_sum``/
     ``_count`` series (Prometheus histogram semantics: each ``le``
@@ -53,7 +61,8 @@ def prometheus_text(snapshot: Dict[str, Any],
                     prefix: str = "porqua_serve",
                     histograms: Optional[Dict[str, Dict[str, Any]]] = None,
                     extra_counters: Optional[Dict[str, Any]] = None,
-                    extra_gauges: Optional[Dict[str, Any]] = None) -> str:
+                    extra_gauges: Optional[Dict[str, Any]] = None,
+                    labeled_gauges: Optional[Dict[str, Any]] = None) -> str:
     """Render one metrics snapshot as Prometheus exposition text.
 
     Every numeric snapshot key is exported; keys in the window-counter
@@ -74,6 +83,13 @@ def prometheus_text(snapshot: Dict[str, Any],
     with ``gauge`` typing — the SLO engine's ``slo_burn_rate`` /
     ``slo_alert_state`` / ``slo_compliance`` series ride this path
     (:meth:`porqua_tpu.obs.slo.SLOEngine.gauges`).
+
+    ``labeled_gauges`` renders label-carrying gauge series:
+    ``{name: [(labels_dict, value), ...]}`` becomes one ``# TYPE``
+    header plus ``<prefix>_<name>{k="v",...} value`` per entry — the
+    executable cache's per-bucket compile-seconds / hit / peak-memory
+    series (:meth:`porqua_tpu.serve.bucketing.ExecutableCache.
+    prometheus_gauges`) ride this path.
     """
     # Imported lazily: serve imports obs, so a module-level import here
     # would be circular; at call time both modules are initialized.
@@ -104,11 +120,27 @@ def prometheus_text(snapshot: Dict[str, Any],
             name = _metric_name(prefix, key)
             lines.append(f"# TYPE {name} {kind}")
             lines.append(f"{name} {value}")
+    for key, series in (labeled_gauges or {}).items():
+        rendered = []
+        for labels, value in series:
+            if isinstance(value, bool):
+                value = int(value)
+            if not isinstance(value, (int, float)):
+                continue
+            lbl = ",".join(f'{k}="{_escape_label(v)}"'
+                           for k, v in sorted(labels.items()))
+            rendered.append((lbl, value))
+        if not rendered:
+            continue
+        name = _metric_name(prefix, key)
+        lines.append(f"# TYPE {name} gauge")
+        for lbl, value in rendered:
+            lines.append(f"{name}{{{lbl}}} {value}")
     device = snapshot.get("device")
     if device:
         name = _metric_name(prefix, "device_info")
         lines.append(f"# TYPE {name} gauge")
-        lines.append(f'{name}{{device="{device}"}} 1')
+        lines.append(f'{name}{{device="{_escape_label(device)}"}} 1')
     return "\n".join(lines) + "\n"
 
 

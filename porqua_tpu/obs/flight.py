@@ -123,6 +123,7 @@ class FlightRecorder:
         self.obs = None
         self.slo = None
         self.anomaly = None
+        self.cache = None
         self._params_repr: Optional[str] = None
         self._extra_config: Dict[str, Any] = {}
         self._lock = tsan.lock("FlightRecorder")
@@ -147,12 +148,18 @@ class FlightRecorder:
     # -- wiring -------------------------------------------------------
 
     def attach(self, metrics=None, obs=None, params=None, slo=None,
-               anomaly=None, extra_config: Optional[Dict[str, Any]] = None
+               anomaly=None, cache=None,
+               extra_config: Optional[Dict[str, Any]] = None
                ) -> "FlightRecorder":
         """Point the recorder at the serve stack's obs surfaces
         (``SolveService`` calls this and registers :meth:`on_event` as
         an event-bus listener). ``params`` feeds the bundle's config
-        fingerprint; ``extra_config`` rides along verbatim."""
+        fingerprint; ``extra_config`` rides along verbatim. ``cache``
+        (an :class:`~porqua_tpu.serve.bucketing.ExecutableCache`)
+        makes each bundle carry the harvested CostRecords of the
+        implicated bucket's executables — the post-mortem sees what
+        XLA thought the failing program cost without rerunning a
+        compile."""
         if metrics is not None:
             self.metrics = metrics
         if obs is not None:
@@ -161,6 +168,8 @@ class FlightRecorder:
             self.slo = slo
         if anomaly is not None:
             self.anomaly = anomaly
+        if cache is not None:
+            self.cache = cache
         if params is not None:
             self._params_repr = repr(params)
         if extra_config:
@@ -310,6 +319,31 @@ class FlightRecorder:
             bundle["slo"] = self.slo.status()
         if self.anomaly is not None:
             bundle["anomaly"] = self.anomaly.status()
+        if self.cache is not None:
+            # Device-truth cost evidence: the CostRecords of the
+            # implicated bucket's executables (triggers that carry a
+            # `bucket` field — dispatch failures, sanitizer refusals,
+            # anomalies), falling back to the whole harvested set
+            # when the trigger names none. Bounded: a cache holds a
+            # handful of executables per bucket, not per request.
+            try:
+                records = self.cache.cost_records()
+            except Exception:  # noqa: BLE001 - evidence, not dependency
+                records = []
+            implicated = trigger.get("bucket")
+            if implicated is not None:
+                # Exact bucket, or its factored variants ("NxM" events
+                # cover "NxMxfR" labels) — a bare prefix would also
+                # swallow unrelated buckets ("32x8" matching "32x80").
+                b = str(implicated)
+                matched = [r for r in records
+                           if str(r.get("bucket", "")) == b
+                           or str(r.get("bucket", "")).startswith(
+                               b + "xf")]
+                if matched:
+                    records = matched
+                bundle["implicated_bucket"] = str(implicated)
+            bundle["cost_records"] = records[:64]
         return bundle
 
     def _store(self, bundle: Dict[str, Any], seq: int, kind: str):

@@ -185,9 +185,10 @@ def qp_solve_profile(n: int, m: int, iters: float, seconds: float,
                      factor_rows: Optional[int] = None,
                      window: Optional[int] = None,
                      device_kind: str = "",
-                     stage_seconds: Optional[Dict[str, float]] = None
+                     stage_seconds: Optional[Dict[str, float]] = None,
+                     cost: Optional[Dict[str, Any]] = None
                      ) -> Dict[str, Any]:
-    """Analytic FLOPs/bytes of the dispatched batch + achieved rates.
+    """FLOPs/bytes of the dispatched batch + achieved rates.
 
     ``seconds`` is the measured wall of the WHOLE ``batch``-lane
     dispatch; the model multiplies per-lane cost by ``batch``
@@ -197,8 +198,21 @@ def qp_solve_profile(n: int, m: int, iters: float, seconds: float,
     problems) re-enables the Gram-assembly accounting; the default 0
     counts only what a pure QP solve runs. MFU fields appear only when
     the device kind maps to known peaks (TPUs) — on XLA-CPU the record
-    carries the analytic cost and achieved rates alone, which is
-    exactly what a later chip window needs for comparison."""
+    carries the cost and achieved rates alone, which is exactly what a
+    later chip window needs for comparison.
+
+    ``cost`` is the dispatched executable's CostRecord
+    (:func:`porqua_tpu.obs.devprof.cost_record`, looked up via
+    :meth:`~porqua_tpu.serve.bucketing.ExecutableCache.
+    cost_record_for`). When it carries XLA-measured flops/bytes, the
+    MFU/bandwidth numerators switch to the compiler's own accounting
+    (``cost_source: "xla"``; ``flops_xla``/``bytes_xla``/
+    ``peak_bytes`` recorded) and the analytic figures stay side by
+    side as ``model_flops``/``model_bytes`` with their
+    ``flops_model_ratio``/``bytes_model_ratio`` — so drift between the
+    hand model and the compiler is itself a tracked metric. Without
+    ``cost``, the analytic model remains the numerator
+    (``cost_source: "model"``) — the pre-device-truth behavior."""
     from porqua_tpu.profiling import admm_flop_model, roofline_report
     from porqua_tpu.qp.solve import SolverParams
 
@@ -214,14 +228,41 @@ def qp_solve_profile(n: int, m: int, iters: float, seconds: float,
         linsolve="trinv" if params.linsolve == "auto" else params.linsolve,
         woodbury_refine=params.woodbury_refine,
     )
+    num_flops = model["flops_total"]
+    num_bytes = model["bytes_total"]
     out: Dict[str, Any] = {
-        "flops_est": model["flops_total"],
-        "bytes_est": model["bytes_total"],
         "seconds": float(seconds),
         "batch": int(batch),
+        "cost_source": "model",
     }
+    xla_flops = None if cost is None else cost.get("flops")
+    xla_bytes = None if cost is None else cost.get("bytes_accessed")
+    if xla_flops or xla_bytes:
+        # Device truth: the executable's own cost analysis becomes the
+        # numerator; the analytic model rides along as the drift probe
+        # (ratio formula shared with bench.py via measured_rates).
+        from porqua_tpu.obs.devprof import measured_rates
+
+        out["cost_source"] = "xla"
+        out["model_flops"] = model["flops_total"]
+        out["model_bytes"] = model["bytes_total"]
+        out.update(measured_rates(cost,
+                                  model_flops=model["flops_total"],
+                                  model_bytes=model["bytes_total"]))
+        if xla_flops:
+            num_flops = float(xla_flops)
+            out["flops_xla"] = num_flops
+        if xla_bytes:
+            num_bytes = float(xla_bytes)
+            out["bytes_xla"] = num_bytes
+        if cost.get("peak_bytes") is not None:
+            out["peak_bytes"] = float(cost["peak_bytes"])
+    out["flops_est"] = num_flops
+    out["bytes_est"] = num_bytes
     if seconds > 0:
-        roof = roofline_report(model, float(seconds), device_kind)
+        roof = roofline_report(
+            {"flops_total": num_flops, "bytes_total": num_bytes},
+            float(seconds), device_kind)
         out["achieved_tflops"] = roof["achieved_tflops"]
         out["achieved_hbm_gbps"] = roof["achieved_hbm_gbps"]
         for key in ("mfu_bf16_peak", "mfu_f32_est", "hbm_utilization",

@@ -300,6 +300,79 @@ def harvest_section(records: Sequence[Dict[str, Any]],
     return "\n".join(lines)
 
 
+def costs_section(records: Sequence[Dict[str, Any]],
+                  harvest: Optional[Sequence[Dict[str, Any]]] = None,
+                  max_rows: int = 12) -> str:
+    """Device cost / memory from a CostRecord dataset: per-bucket peak
+    device memory and XLA-measured bytes per executable (what the
+    compiler said the programs cost), plus — when harvest records with
+    measured (``cost_source: "xla"``) profiles are given — the
+    measured-vs-model table: the analytic flop model's drift against
+    the compiler per bucket, the number that says whether the hand
+    roofline can still be trusted."""
+    records = list(records)
+    if not records:
+        return "device cost / memory: (no CostRecords)"
+    lines = [f"device cost / memory ({len(records)} CostRecords)"]
+    by_bucket: Dict[str, List[Dict[str, Any]]] = {}
+    for rec in records:
+        by_bucket.setdefault(str(rec.get("bucket", "?")), []).append(rec)
+    lines.append(f"  {'bucket':<14} {'exes':>4} {'peak MB (max)':>13} "
+                 f"{'MB accessed (max)':>17} {'compile s':>9}")
+    for bucket in sorted(by_bucket):
+        recs = by_bucket[bucket]
+        peaks = [r["peak_bytes"] for r in recs if r.get("peak_bytes")]
+        bytes_ = [r["bytes_accessed"] for r in recs
+                  if r.get("bytes_accessed")]
+        compile_s = sum(float(r.get("compile_s") or 0.0) for r in recs)
+        lines.append(
+            f"  {bucket:<14} {len(recs):>4} "
+            f"{(max(peaks) / 1e6 if peaks else 0.0):>13.2f} "
+            f"{(max(bytes_) / 1e6 if bytes_ else 0.0):>17.2f} "
+            f"{compile_s:>9.2f}")
+    rows = records[:max_rows]
+    lines.append("  per executable (bytes = XLA cost analysis):")
+    for r in rows:
+        ba, pk = r.get("bytes_accessed"), r.get("peak_bytes")
+        lines.append(
+            f"    {str(r.get('kind')):<11} {str(r.get('entry')):<9} "
+            f"{str(r.get('bucket')):<12} x{r.get('slots') or 0:<5} "
+            f"{(ba or 0) / 1e6:>10.2f} MB acc  "
+            f"{(pk or 0) / 1e6:>8.2f} MB peak  "
+            f"hlo {str(r.get('hlo_hash'))[:8]}")
+    if harvest:
+        measured = [h for h in harvest
+                    if (h.get("profile") or {}).get("cost_source")
+                    == "xla"]
+        if measured:
+            lines.append("  measured-vs-model (per bucket; ratio = "
+                         "analytic model / XLA):")
+            groups: Dict[str, List[Dict[str, Any]]] = {}
+            for h in measured:
+                groups.setdefault(str(h.get("bucket", "?")),
+                                  []).append(h["profile"])
+            for bucket in sorted(groups):
+                profs = groups[bucket]
+                fr = [p["flops_model_ratio"] for p in profs
+                      if p.get("flops_model_ratio")]
+                br = [p["bytes_model_ratio"] for p in profs
+                      if p.get("bytes_model_ratio")]
+                mfu = [p["mfu_bf16_peak"] for p in profs
+                       if p.get("mfu_bf16_peak") is not None]
+                line = (f"    {bucket:<14} x{len(profs):<5}"
+                        f" flops model/xla "
+                        f"{(np.mean(fr) if fr else 0.0):.3f}"
+                        f"  bytes model/xla "
+                        f"{(np.mean(br) if br else 0.0):.3f}")
+                if mfu:
+                    line += f"  mfu(bf16) {np.mean(mfu):.4f}"
+                lines.append(line)
+        else:
+            lines.append("  measured-vs-model: (no harvest records "
+                         "with XLA-measured profiles)")
+    return "\n".join(lines)
+
+
 #: Event kinds rendered on the SLO/alert timeline (alert transitions
 #: interleaved with the breaker and anomaly activity that explains
 #: them).
@@ -375,7 +448,8 @@ def events_section(events: Sequence[Dict[str, Any]],
 def render_report(trace: Any = None,
                   events: Optional[Sequence[Dict[str, Any]]] = None,
                   snapshot: Optional[Dict[str, Any]] = None,
-                  harvest: Optional[Sequence[Dict[str, Any]]] = None) -> str:
+                  harvest: Optional[Sequence[Dict[str, Any]]] = None,
+                  costs: Optional[Sequence[Dict[str, Any]]] = None) -> str:
     """The full text report from whichever artifacts exist."""
     sections = []
     if snapshot is not None:
@@ -389,8 +463,10 @@ def render_report(trace: Any = None,
         sections.append(events_section(events))
     if harvest is not None:
         sections.append(harvest_section(harvest))
+    if costs is not None:
+        sections.append(costs_section(costs, harvest=harvest))
     if not sections:
         return ("obs_report: no artifacts given "
-                "(need --trace/--events/--metrics/--harvest)")
+                "(need --trace/--events/--metrics/--harvest/--costs)")
     rule = "-" * 64
     return f"\n{rule}\n".join(sections)

@@ -385,15 +385,20 @@ class MicroBatcher:
         rr = None if rp is None else np.asarray(sol.ring_rho)
         profile = None
         if self.harvest is not None:
-            # Per-dispatch roofline estimate, shared by the dispatch's
-            # lanes (the device ran ONE batched program): analytic cost
-            # of this bucket's solve at this width vs measured seconds.
+            # Per-dispatch roofline, shared by the dispatch's lanes
+            # (the device ran ONE batched program): XLA's own cost
+            # analysis of this bucket's executable at this width vs
+            # measured seconds — the analytic model stays side-by-side
+            # as the drift probe (qp_solve_profile cost= docs).
             fr = (None if getattr(qp, "Pf", None) is None
                   else int(np.shape(qp.Pf)[-2]))
+            cost = self.cache.cost_record_for(
+                bucket, slots, dtype, kind="solve",
+                device_label=device_label)
             profile = _profile.qp_solve_profile(
                 bucket.n, bucket.m, float(iters[:len(live)].mean()),
                 solve_s, params=self.cache.params, batch=slots,
-                factor_rows=fr, device_kind=device_kind)
+                factor_rows=fr, device_kind=device_kind, cost=cost)
         done = time.monotonic()
         # The fused batch steps EVERY lane until the slowest converges
         # (converged lanes ride frozen): the executed segment count is

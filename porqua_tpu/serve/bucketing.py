@@ -133,15 +133,41 @@ class ExecutableCache:
     """
 
     def __init__(self, params: SolverParams = SolverParams(),
-                 metrics=None, events=None) -> None:
+                 metrics=None, events=None, cost_log=None) -> None:
         self.params = params
         self.metrics = metrics
         # Optional porqua_tpu.obs.EventBus: every AOT compile becomes a
         # structured event (post-warmup ones at "warn" — they are the
         # steady-state-recompile regression the counters gate on).
         self.events = events
+        # Device-truth cost warehouse (porqua_tpu.obs.devprof): every
+        # compiled executable's XLA cost_analysis / memory_analysis is
+        # harvested into one CostRecord — flops, bytes accessed, peak
+        # memory, compile seconds, HLO fingerprint. Runs once per
+        # compile, strictly host-side (contract GC107 pins that the
+        # plane changes no traced program; cost_log=False disables it
+        # entirely, pinned bit-identical by tests/test_devprof.py).
+        # None = a default in-memory CostLog, so per-bucket peak-memory
+        # gauges always have data; pass CostLog(path) to persist.
+        if cost_log is False:
+            self.cost_log = None
+        elif cost_log is None:
+            from porqua_tpu.obs.devprof import CostLog
+
+            self.cost_log = CostLog()
+        else:
+            self.cost_log = cost_log
         self._lock = tsan.lock("ExecutableCache")
         self._cache: Dict[tuple, object] = {}  # guarded-by: self._lock
+        # Latest CostRecord per (kind, bucket, slots, dtype, device,
+        # entry) — the lookup the batcher's measured profile and the
+        # flight recorder's incident bundles read.
+        self._cost_records: Dict[tuple, dict] = {}  # guarded-by: self._lock
+        # Per-bucket cache health: hits / misses / compile seconds,
+        # keyed by the bucket label ("NxM"[xfR]). Cumulative (cache
+        # state, not window state): prewarm compiles are exactly what
+        # a scraper wants to see here.
+        self._bucket_stats: Dict[str, Dict[str, float]] = {}  # guarded-by: self._lock
         # key -> threading.Event while a compile for it is in flight
         # (set + removed by the builder; waiters re-read the cache)
         self._inflight: Dict[tuple, threading.Event] = {}  # guarded-by: self._lock
@@ -167,6 +193,18 @@ class ExecutableCache:
         if device is None:
             return ("default",)
         return (device.platform, device.id)
+
+    @staticmethod
+    def _bucket_label(bucket: Bucket) -> str:
+        label = f"{bucket.n}x{bucket.m}"
+        if bucket.factor_rows is not None:
+            label += f"xf{bucket.factor_rows}"
+        return label
+
+    def _bucket_stat(self, bucket: Bucket) -> Dict[str, float]:  # guarded-by: self._lock
+        return self._bucket_stats.setdefault(
+            self._bucket_label(bucket),
+            {"cache_hits": 0, "compiles": 0, "compile_seconds": 0.0})
 
     def get(self, bucket: Bucket, slots: int, dtype, device=None):
         """The compiled executable for one (bucket, batch, device)."""
@@ -206,6 +244,7 @@ class ExecutableCache:
                 exe = self._cache.get(key)
                 if exe is not None:
                     hit = True
+                    self._bucket_stat(bucket)["cache_hits"] += 1
                 else:
                     hit = False
                     wait_for = self._inflight.get(key)
@@ -276,6 +315,12 @@ class ExecutableCache:
             if pending is not None:
                 pending.set()
         seconds = time.perf_counter() - t0
+        with self._lock:
+            stat = self._bucket_stat(bucket)
+            stat["compiles"] += 1
+            stat["compile_seconds"] += seconds
+        self._harvest_cost(bucket, slots, dtype, dev_key, kind, exe,
+                           seconds)
         if self.metrics is not None:
             self.metrics.observe_compile(seconds)
         if self.events is not None:
@@ -286,6 +331,119 @@ class ExecutableCache:
                 device=str(dev_key), seconds=round(seconds, 4),
                 post_warmup=post_warmup)
         return exe
+
+    def _harvest_cost(self, bucket: Bucket, slots: int, dtype,
+                      dev_key, kind: str, exe, seconds: float) -> None:
+        """Harvest the freshly-compiled executable's XLA cost/memory
+        analysis into CostRecords (one for a one-shot solve, three for
+        the continuous admit/step/finalize triple — the compile event
+        stays one, the cost truth is per program). Host-only, once per
+        compile, never raises."""
+        if self.cost_log is None:
+            return
+        try:
+            from porqua_tpu.obs.devprof import cost_record
+
+            label = self._bucket_label(bucket)
+            dev_label = ":".join(str(p) for p in dev_key)
+            dtype_str = np.dtype(dtype).str
+            if kind == "continuous":
+                entries = list(zip(("admit", "step", "finalize"), exe[:3]))
+            else:
+                entries = [("solve", exe)]
+            for entry, compiled in entries:
+                rec = cost_record(
+                    compiled, entry=entry, kind=kind, bucket=label,
+                    slots=int(slots), dtype=dtype_str, device=dev_label,
+                    compile_s=seconds)
+                with self._lock:
+                    self._cost_records[
+                        (kind, label, int(slots), dtype_str, dev_label,
+                         entry)] = rec
+                self.cost_log.emit(rec)
+        except Exception:  # noqa: BLE001 - cost truth is evidence, not
+            # a dependency: a backend that refuses an analysis (or a
+            # jax version that renames one) must not fail the compile.
+            pass
+
+    # -- device-truth readers ------------------------------------------
+
+    def cost_records(self) -> list:
+        """Every harvested CostRecord (latest per executable identity)."""
+        with self._lock:
+            return [dict(r) for r in self._cost_records.values()]
+
+    def cost_record_for(self, bucket: Bucket, slots: int, dtype,
+                        kind: str = "solve",
+                        entry: Optional[str] = None,
+                        device_label: Optional[str] = None):
+        """The CostRecord of one cached executable, or ``None`` —
+        the batcher reads this to switch a dispatch's MFU/bandwidth
+        numerators from the analytic model to XLA's own accounting.
+        ``device_label`` (``"platform:id"``) narrows to one device;
+        ``None`` matches any (program cost is device-kind-invariant
+        for a fixed backend, and the caller usually knows the label)."""
+        if entry is None:
+            entry = "step" if kind == "continuous" else "solve"
+        label = self._bucket_label(bucket)
+        dtype_str = np.dtype(dtype).str
+        with self._lock:
+            if device_label is not None:
+                rec = self._cost_records.get(
+                    (kind, label, int(slots), dtype_str, device_label,
+                     entry))
+                return None if rec is None else dict(rec)
+            for key, rec in self._cost_records.items():
+                if key[:4] == (kind, label, int(slots), dtype_str) \
+                        and key[5] == entry:
+                    return dict(rec)
+        return None
+
+    def bucket_stats(self) -> Dict[str, Dict[str, float]]:
+        """Per-bucket cache health: hits, compiles (== misses that
+        built), cumulative compile seconds, and the max harvested
+        peak-memory / bytes-accessed across the bucket's executables."""
+        with self._lock:
+            out = {label: dict(stat)
+                   for label, stat in self._bucket_stats.items()}
+            for (kind, label, slots, _dt, _dev, entry), rec \
+                    in self._cost_records.items():
+                stat = out.setdefault(
+                    label, {"cache_hits": 0, "compiles": 0,
+                            "compile_seconds": 0.0})
+                for field, key in (("peak_bytes_max", "peak_bytes"),
+                                   ("bytes_accessed_max",
+                                    "bytes_accessed")):
+                    v = rec.get(key)
+                    if v is not None:
+                        stat[field] = max(stat.get(field, 0.0), float(v))
+        return out
+
+    def prometheus_gauges(self) -> Dict[str, list]:
+        """Per-bucket cache-health series for the ``/metrics``
+        exposition (``prometheus_text(labeled_gauges=...)``): compile
+        seconds, compile and hit counters, and peak device memory —
+        cache health was previously visible only as EventBus events."""
+        stats = self.bucket_stats()
+        out: Dict[str, list] = {
+            "bucket_compile_seconds_total": [],
+            "bucket_compiles_total": [],
+            "bucket_cache_hits_total": [],
+            "bucket_peak_bytes": [],
+        }
+        for label in sorted(stats):
+            stat = stats[label]
+            tag = {"bucket": label}
+            out["bucket_compile_seconds_total"].append(
+                (tag, stat.get("compile_seconds", 0.0)))
+            out["bucket_compiles_total"].append(
+                (tag, stat.get("compiles", 0)))
+            out["bucket_cache_hits_total"].append(
+                (tag, stat.get("cache_hits", 0)))
+            if "peak_bytes_max" in stat:
+                out["bucket_peak_bytes"].append(
+                    (tag, stat["peak_bytes_max"]))
+        return out
 
     @property
     def warmed(self) -> bool:

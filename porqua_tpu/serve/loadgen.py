@@ -108,7 +108,10 @@ def run_loadgen(requests: List[CanonicalQP],
                 slo=False,
                 slo_latency_target_s: float = 0.25,
                 flight_out=None,
-                anomaly_baseline=None) -> Dict:
+                anomaly_baseline=None,
+                cost_out: Optional[str] = None,
+                profile_window_s: Optional[float] = None,
+                profile_dir: Optional[str] = None) -> Dict:
     """Drive ``requests`` through a :class:`SolveService`; return the
     report dict (throughput, percentiles, occupancy, recompiles).
 
@@ -182,6 +185,18 @@ def run_loadgen(requests: List[CanonicalQP],
     against per-(bucket, eps) harvest baselines. Like ``harvest_out``,
     all three wire at service construction, so they require the
     service to be created here (raises against an external one).
+
+    Device truth (README "Device-truth profiling"): the executable
+    cache harvests every compile's XLA ``cost_analysis()`` /
+    ``memory_analysis()`` into CostRecords by default; ``cost_out``
+    additionally exports them as a JSONL(.gz) dataset (the
+    ``scripts/roofline_report.py`` input) and the report always
+    carries a ``cost_summary`` (executable count, max measured bytes
+    / peak memory). ``profile_window_s`` opens a bounded programmatic
+    ``jax.profiler`` trace over the start of the measured phase
+    (stopped by a timer after that many seconds, or at run end if
+    sooner) written under ``profile_dir`` — the report links it as
+    ``profile_trace_dir``.
     """
     if mode not in ("closed", "open"):
         raise ValueError(f"unknown mode {mode!r}; expected closed|open")
@@ -324,6 +339,13 @@ def run_loadgen(requests: List[CanonicalQP],
                 "it without SolveService(retry=...) to measure raw "
                 "fault behavior")
     injector = None
+    window_trace = None
+    if profile_window_s is not None or profile_dir is not None:
+        from porqua_tpu.obs.devprof import ProfileWindow
+
+        window_trace = ProfileWindow(
+            profile_dir or "porqua_profile_trace",
+            window_s=profile_window_s)
     try:
         # Prewarm every slot-ladder executable for the stream's bucket,
         # then reset the window: measured `compiles` == recompiles.
@@ -349,6 +371,14 @@ def run_loadgen(requests: List[CanonicalQP],
             injector = _faults.install(_faults.FaultInjector(
                 scenario, metrics=service.metrics,
                 events=None if obs is None else obs.events))
+
+        if window_trace is not None:
+            # The profiler window opens with the measured phase (after
+            # prewarm + warmup, so the trace captures steady-state
+            # dispatches, not compiles) and is BOUNDED: a daemon timer
+            # stops it after profile_window_s even if the run hangs;
+            # the teardown stop below is the idempotent second closer.
+            window_trace.start()
 
         errors: List[str] = []
         tickets = []
@@ -410,6 +440,11 @@ def run_loadgen(requests: List[CanonicalQP],
             except Exception as exc:  # noqa: BLE001 - reported, not fatal
                 errors.append(f"{type(exc).__name__}: {exc}")
         elapsed = time.perf_counter() - t0
+        if window_trace is not None:
+            # Stop before the report: stopping flushes the trace files
+            # so the linked directory is complete when the report line
+            # naming it prints (a no-op when the timer already fired).
+            window_trace.stop()
         if injector is not None:
             # Close the chaos window before reading the final state:
             # the report describes a service that has been through its
@@ -493,6 +528,35 @@ def run_loadgen(requests: List[CanonicalQP],
             ast = anomaly.status()
             obs_fields["convergence_anomalies"] = ast["fired"]
             obs_fields["anomalous_groups"] = ast["anomalous"]
+        # Device-truth cost summary: what XLA said the run's compiled
+        # executables cost (always harvested by the cache; cost_out
+        # additionally persists the records for roofline_report.py).
+        cost_records = []
+        try:
+            cost_records = service.cache.cost_records()
+        except Exception:  # noqa: BLE001 - evidence, not a dependency
+            pass
+        if cost_records:
+            bytes_vals = [r["bytes_accessed"] for r in cost_records
+                          if r.get("bytes_accessed")]
+            peak_vals = [r["peak_bytes"] for r in cost_records
+                         if r.get("peak_bytes")]
+            obs_fields["cost_summary"] = {
+                "executables": len(cost_records),
+                "bytes_accessed_max": max(bytes_vals) if bytes_vals else None,
+                "peak_bytes_max": max(peak_vals) if peak_vals else None,
+            }
+        if cost_out:
+            from porqua_tpu.obs.devprof import write_cost_records
+
+            obs_fields["cost_out"] = cost_out
+            obs_fields["cost_records"] = write_cost_records(
+                cost_out, cost_records)
+        if window_trace is not None:
+            obs_fields["profile_trace_dir"] = window_trace.logdir
+            obs_fields["profile_window_s"] = profile_window_s
+            if window_trace.error:
+                obs_fields["profile_trace_error"] = window_trace.error
         if sink is not None:
             sink.flush()
             obs_fields.update({
@@ -551,6 +615,11 @@ def run_loadgen(requests: List[CanonicalQP],
             "iters_mean": snap["iters_mean"],
         }
     finally:
+        if window_trace is not None:
+            # Exception path: a dangling profiler trace would make the
+            # NEXT run's start_trace raise (idempotent on the clean
+            # path — the in-run stop already closed it).
+            window_trace.stop()
         if injector is not None:
             # Exception path: the injector must not outlive this run
             # (a process-global injector would perturb the next one).

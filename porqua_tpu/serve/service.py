@@ -280,6 +280,7 @@ class SolveService:
                  segment_budget: Optional[int] = None,
                  retry=None,
                  cache: Optional[ExecutableCache] = None,
+                 cost_log=None,
                  harvest=None,
                  profiler=None,
                  slo=None,
@@ -325,7 +326,10 @@ class SolveService:
             # already produces: the metrics snapshot trajectory, the
             # event/span rings, recent SolveRecords (fed by the
             # batchers), and the SLO/anomaly status at dump time. Its
-            # trigger feed is the event bus itself.
+            # trigger feed is the event bus itself. (The executable
+            # cache is attached below, once it exists — its
+            # CostRecords make the bundle say what XLA thought the
+            # implicated program cost, without rerunning a compile.)
             flight.attach(metrics=self.metrics, obs=obs, params=params,
                           slo=slo, anomaly=anomaly)
             events.add_listener(flight.on_event)
@@ -342,8 +346,12 @@ class SolveService:
             # this service's bus unless it already has its own.
             self.health.events = events
         if cache is None:
+            # cost_log threads through to the device-truth cost
+            # warehouse (porqua_tpu.obs.devprof): None = in-memory
+            # default, a CostLog(path) persists CostRecords, False
+            # disables harvesting entirely.
             cache = ExecutableCache(params, metrics=self.metrics,
-                                    events=events)
+                                    events=events, cost_log=cost_log)
         elif cache.params != params:
             # A shared cache (e.g. the chaos suite reusing compiled
             # executables across scenario services) must solve at THIS
@@ -352,6 +360,8 @@ class SolveService:
                 "shared ExecutableCache was built for different "
                 "SolverParams than this service's")
         self.cache = cache
+        if flight is not None:
+            flight.attach(cache=self.cache)
         # Optional request-level recovery layer
         # (porqua_tpu.resilience.retry): retry with backoff + jitter,
         # idempotent resubmission by request id, deadline-aware
@@ -435,7 +445,8 @@ class SolveService:
                     self.snapshot(),
                     histograms=self.metrics.histograms(),
                     extra_counters=self._obs_counters(),
-                    extra_gauges=self._slo_gauges()),
+                    extra_gauges=self._slo_gauges(),
+                    labeled_gauges=self.cache.prometheus_gauges()),
                 health_fn=self._health_payload, host=host, port=port)
         return self._http.start()
 
@@ -463,6 +474,8 @@ class SolveService:
             out["spans_dropped"] = self.obs.spans.dropped
         if self.harvest is not None:
             out.update(self.harvest.counters())
+        if getattr(self.cache, "cost_log", None) is not None:
+            out.update(self.cache.cost_log.counters())
         if self.slo is not None:
             out.update(self.slo.counters())
         if self.flight is not None:
@@ -485,6 +498,13 @@ class SolveService:
             # human) sees event/harvest loss without scraping the full
             # exposition.
             **self._obs_counters(),
+            # Device-truth cache health: per-bucket compile seconds,
+            # hit/compile counters, and harvested peak device memory —
+            # cache health without parsing the full exposition.
+            "cache": {
+                "executables": len(self.cache),
+                "buckets": self.cache.bucket_stats(),
+            },
         }
         if self.slo is not None:
             # SLO status from one endpoint: per-SLO compliance, the

@@ -22,6 +22,12 @@ warehouse & bench gate"):
   ratios with generous default tolerances (shared CI hosts jitter;
   ``--tolerance-scale`` tightens or loosens every ratio rule at once
   for quiet vs noisy environments).
+* **cost / memory** — the device-truth rules: XLA-measured flops /
+  bytes-accessed of the headline and serving executables inside a
+  tight relative band (these are deterministic per program — drift
+  means the compiled program changed, e.g. a silent recompile-shape
+  or fusion regression), and peak device memory bounded one-sided
+  (growth past the band fails; shrinking passes).
 
 A metric absent from the BASELINE is skipped (older artifacts predate
 newer payload parts — BENCH_r05 has no ``config_serving``); a metric
@@ -88,6 +94,25 @@ RULES = [
      "abs_delta", 0.05, "performance"),
     ("compaction_reduction", "config_compaction.lane_segments_reduction",
      "ratio_min", 0.8, "performance"),
+    # -- device truth: XLA cost / memory ------------------------------
+    # The compiler's own accounting of the headline executable
+    # (bench.py xla_cost, from compiled.cost_analysis() /
+    # memory_analysis()). These numbers are deterministic per program:
+    # a drift means the compiled program changed — a silent
+    # recompile-shape change, a lost fusion, a dependency bump
+    # rewriting the HLO — exactly the regressions wall-clock noise
+    # hides. Peak memory is one-sided (shrinking is fine; growth past
+    # the band is how a chip-window OOM announces itself early).
+    ("xla_flops_drift", "xla_cost.flops", "rel_band", 0.10, "cost"),
+    ("xla_bytes_drift", "xla_cost.bytes_accessed",
+     "rel_band", 0.10, "cost"),
+    ("xla_peak_memory", "xla_cost.peak_bytes",
+     "ratio_max", 1.15, "memory"),
+    ("serving_peak_memory", "config_serving.cost_summary.peak_bytes_max",
+     "ratio_max", 1.15, "memory"),
+    ("serving_bytes_drift",
+     "config_serving.cost_summary.bytes_accessed_max",
+     "rel_band", 0.10, "cost"),
 ]
 
 #: Ratio tolerances scaled by --tolerance-scale (invariants never are).
@@ -236,9 +261,14 @@ def _synthetic_baseline() -> Dict[str, Any]:
         "device_solved": 252, "device_median_te": 6.138e-4,
         "linsolve": "trinv", "iters_p95": 25.0,
         "wasted_iteration_fraction": 0.0,
+        "xla_cost": {"flops": 2.4e12, "bytes_accessed": 8.1e10,
+                     "peak_bytes": 9.2e8},
         "config_serving": {"throughput_solves_per_s": 3383.0,
                            "latency_p99_ms": 120.0,
-                           "recompiles_after_warmup": 0},
+                           "recompiles_after_warmup": 0,
+                           "cost_summary": {"executables": 16,
+                                            "bytes_accessed_max": 6.5e8,
+                                            "peak_bytes_max": 4.2e7}},
         "config_compaction": {"recompiles_in_measured_solve": 0,
                               "te_drift": 3.2e-9,
                               "lane_segments_reduction": 0.331},
@@ -258,21 +288,34 @@ def _selftest() -> int:
     assert v_good["n_skip"] == 0, v_good
 
     # A synthetically regressed payload: speedup and throughput
-    # halved, a steady-state recompile, bit-parity broken — every
-    # class of rule must trip its own check.
+    # halved, a steady-state recompile, bit-parity broken, XLA cost
+    # drifted and peak memory blown — every class of rule (incl. the
+    # device-truth cost/memory rules) must trip its own check.
     bad = json.loads(json.dumps(base))
     bad["vs_baseline"] *= 0.5
     bad["config_serving"]["throughput_solves_per_s"] *= 0.4
     bad["config_serving"]["recompiles_after_warmup"] = 2
     bad["config_compaction"]["te_drift"] = 1e-3
     bad["device_solved"] = 240
+    bad["xla_cost"]["flops"] *= 1.5           # program changed
+    bad["xla_cost"]["peak_bytes"] *= 2.0      # memory blow-up
+    bad["config_serving"]["cost_summary"]["peak_bytes_max"] *= 1.5
     v_bad = check_payload(base, bad)
     assert not v_bad["ok"], "selftest: regressed payload passed"
     for name in ("headline_speedup", "serving_throughput",
                  "serving_recompiles", "compaction_te_parity",
-                 "solved_lanes"):
+                 "solved_lanes", "xla_flops_drift", "xla_peak_memory",
+                 "serving_peak_memory"):
         assert name in v_bad["failed"], \
             f"selftest: {name} not in {v_bad['failed']}"
+    # One-sidedness: memory that SHRINKS passes; bytes that drift in
+    # either direction past the band fail.
+    better = json.loads(json.dumps(base))
+    better["xla_cost"]["peak_bytes"] *= 0.5
+    better["xla_cost"]["bytes_accessed"] *= 0.8
+    v_better = check_payload(base, better)
+    assert "xla_peak_memory" not in v_better["failed"], v_better["failed"]
+    assert "xla_bytes_drift" in v_better["failed"], v_better["failed"]
 
     # Baseline-missing metrics skip (old artifacts), candidate-missing
     # metrics fail (coverage regression).

@@ -16,6 +16,11 @@ Consumes the artifacts a traced run emits and prints one text report:
   (``serve_loadgen.py --harvest-out`` / ``HarvestSink``): convergence
   sparklines per status class + wasted-iteration attribution by
   (bucket, eps). The full policy table: ``scripts/harvest_report.py``.
+* ``--costs costs.jsonl[.gz]`` — a device-truth CostRecord dataset
+  (``serve_loadgen.py --cost-out`` / ``CostLog``): per-bucket peak
+  device memory, XLA-measured bytes per executable, and — joined with
+  ``--harvest`` — the measured-vs-model MFU table. The fusion-target
+  ranking: ``scripts/roofline_report.py``.
 
 ``--selftest`` builds a synthetic run in-process (no JAX, no service)
 and checks the rendering pipeline end to end — the cheap CI smoke
@@ -150,8 +155,36 @@ def _selftest() -> int:
                 "occupancy_mean": 0.91, "queue_wait_seconds": 0.03,
                 "solve_seconds": 0.02, "compiles": 0,
                 "device": "cpu:0", "degraded": False}
+    # A synthetic device-truth CostRecord set (round-tripped through
+    # the real on-disk format) + one harvest record with a measured
+    # (cost_source: xla) profile, so the measured-vs-model table
+    # renders.
+    from porqua_tpu.obs.devprof import load_cost_records, write_cost_records
+
+    costs = [
+        {"v": 1, "t": 0.0, "kind": "solve", "entry": "solve",
+         "bucket": "32x8", "slots": 8, "dtype": "<f4", "device": "cpu:0",
+         "compile_s": 1.5, "flops": 4.2e8, "bytes_accessed": 6.5e8,
+         "peak_bytes": 4.2e7, "hlo_hash": "deadbeefcafef00d"},
+        {"v": 1, "t": 0.0, "kind": "continuous", "entry": "step",
+         "bucket": "32x8", "slots": 8, "dtype": "<f4", "device": "cpu:0",
+         "compile_s": 0.9, "flops": 1.0e8, "bytes_accessed": 9.0e8,
+         "peak_bytes": 5.1e7, "hlo_hash": "0123456789abcdef"},
+    ]
+    with tempfile.TemporaryDirectory() as td:
+        cpath = os.path.join(td, "costs.jsonl.gz")
+        assert write_cost_records(cpath, costs) == 2
+        costs = load_cost_records(cpath)
+    harvest.append({
+        "v": 1, "source": "serve", "n": 24, "m": 1, "status": 1,
+        "iters": 50, "prim_res": 1e-6, "dual_res": 1e-7, "obj_val": 0.0,
+        "bucket": "32x8",
+        "profile": {"cost_source": "xla", "flops_est": 4.2e8,
+                    "bytes_est": 6.5e8, "model_flops": 5.0e8,
+                    "model_bytes": 5.2e8, "flops_model_ratio": 1.19,
+                    "bytes_model_ratio": 0.8, "peak_bytes": 4.2e7}})
     text = render_report(trace=trace, events=events, snapshot=snapshot,
-                         harvest=harvest)
+                         harvest=harvest, costs=costs)
     for needle in ("stage waterfall", "queue_wait", "span coverage",
                    "convergence rings", "breaker_open",
                    "latency / throughput", "faults / recovery",
@@ -166,7 +199,13 @@ def _selftest() -> int:
                    "availability/fast -> firing",
                    "availability/fast -> resolved",
                    "anomaly    32x8 -> firing",
-                   "alerts: 1 fired / 1 resolved"):
+                   "alerts: 1 fired / 1 resolved",
+                   # The device cost / memory section: per-bucket peak
+                   # memory + the measured-vs-model drift table.
+                   "device cost / memory (2 CostRecords)",
+                   "hlo deadbeef",
+                   "measured-vs-model",
+                   "flops model/xla 1.190"):
         assert needle in text, f"selftest: {needle!r} missing from report"
     print(text)
     print("\nobs_report selftest: ok")
@@ -184,6 +223,10 @@ def main() -> int:
     ap.add_argument("--harvest", default=None,
                     help="telemetry-warehouse dataset (HarvestSink "
                          "JSONL/.gz): convergence-analytics section")
+    ap.add_argument("--costs", default=None,
+                    help="device-truth CostRecord dataset (CostLog "
+                         "JSONL/.gz, serve_loadgen --cost-out): "
+                         "device cost/memory section")
     ap.add_argument("--selftest", action="store_true",
                     help="render a synthetic run and verify the pipeline")
     args = ap.parse_args()
@@ -191,9 +234,10 @@ def main() -> int:
     if args.selftest:
         return _selftest()
 
-    from porqua_tpu.obs import load_harvest, load_jsonl, render_report
+    from porqua_tpu.obs import (
+        load_cost_records, load_harvest, load_jsonl, render_report)
 
-    trace = events = snapshot = harvest = None
+    trace = events = snapshot = harvest = costs = None
     if args.trace:
         with open(args.trace) as f:
             trace = json.load(f)
@@ -204,9 +248,11 @@ def main() -> int:
         snapshot = lines[-1] if lines else None
     if args.harvest:
         harvest = load_harvest(args.harvest)
+    if args.costs:
+        costs = load_cost_records(args.costs)
 
     print(render_report(trace=trace, events=events, snapshot=snapshot,
-                        harvest=harvest))
+                        harvest=harvest, costs=costs))
     return 0
 
 

@@ -19,7 +19,7 @@ shared state inferred from the thread-root reachability graph, static
 lock-order deadlock detection, and blocking-calls-under-a-lock — whose
 runtime half is the ``PORQUA_TSAN=1`` lock-order sanitizer exercised
 by ``scripts/tsan_smoke.py``) plus the trace-time jaxpr contracts
-(GC101-GC106) against the real batch entry points on the XLA-CPU
+(GC101-GC107) against the real batch entry points on the XLA-CPU
 backend: default solver params, the convergence-ring telemetry
 variant (``SolverParams(ring_size>0)``), the compaction
 step-and-repack program (dense + factored — the machine-checked proof
@@ -34,7 +34,12 @@ zero callbacks/transfers to any jitted entry), and the GC106
 observability-identity contract (the live SLO engine / flight
 recorder / anomaly detector, exercised through a firing alert and an
 incident dump, leave the solve/serve/compaction jaxprs string-
-identical). Exit status: 0 clean, 1 findings, 2 internal/usage error.
+identical), and the GC107 devprof-identity contract (a real AOT
+compile harvested into a CostRecord through a live CostLog plus a
+measured qp_solve_profile leave the solve/serve jaxprs string-
+identical — the device-truth cost plane reads compiled objects,
+never traced ones). Exit status: 0 clean, 1 findings, 2
+internal/usage error.
 
 Options:
     --format {text,json}   output format (default text)
@@ -107,7 +112,7 @@ def main(argv=None) -> int:
 
     if not args.no_contracts and (
             rules is None or rules & {"GC101", "GC102", "GC103", "GC104",
-                                      "GC105", "GC106"}):
+                                      "GC105", "GC106", "GC107"}):
         try:
             import jax
 

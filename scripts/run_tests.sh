@@ -70,6 +70,19 @@ else
     fail=1
 fi
 
+# roofline_report: the device-truth roofline pipeline (synthetic
+# CostRecord warehouse -> fusion-target verdict, JSONL/.gz round-trip,
+# no JAX backend) must keep ranking fusion candidates — the evidence
+# artifact the ROADMAP fusion item consumes (README "Device-truth
+# profiling").
+if out=$(timeout 120 python scripts/roofline_report.py --selftest 2>&1); then
+    echo "OK   roofline_report --selftest: $(echo "$out" | tail -1)"
+else
+    echo "FAIL roofline_report --selftest:"
+    echo "$out"
+    fail=1
+fi
+
 # bench_gate: the BENCH-artifact regression differ (synthetic baseline
 # vs passing AND regressed payloads, plus the committed BENCH_r05
 # self-gate) — every future PR's perf claim is checked by this tool,

@@ -28,6 +28,11 @@ Examples::
                                    # recorder + convergence anomaly
                                    # detection (scripts/incident_report.py
                                    # renders the bundles)
+    python scripts/serve_loadgen.py --cost-out costs.jsonl \\
+        --profile-window 5 --profile-dir /tmp/ptrace
+                                   # device-truth CostRecords + a bounded
+                                   # jax.profiler trace; rank fusion
+                                   # targets: scripts/roofline_report.py
 
 Prints one JSON report line on stdout (diagnostics on stderr), in the
 same one-line-artifact style as ``bench.py``.
@@ -99,6 +104,21 @@ def main() -> int:
                          "convergence anomaly detection against; "
                          "convergence_anomaly events feed the flight "
                          "recorder")
+    ap.add_argument("--cost-out", default=None, metavar="PATH",
+                    help="export the run's device-truth CostRecords "
+                         "(XLA cost_analysis/memory_analysis per "
+                         "compiled executable) as JSONL (.gz gzips) — "
+                         "the scripts/roofline_report.py input; a "
+                         "cost_summary joins the report either way")
+    ap.add_argument("--profile-window", type=float, default=None,
+                    metavar="S",
+                    help="open a bounded programmatic jax.profiler "
+                         "trace over the first S seconds of the "
+                         "measured (post-warmup) phase; the report "
+                         "links the trace dir as profile_trace_dir")
+    ap.add_argument("--profile-dir", default=None, metavar="DIR",
+                    help="trace directory for --profile-window "
+                         "(default: porqua_profile_trace)")
     ap.add_argument("--rings", type=int, default=0, metavar="K",
                     help="compile with K-slot on-device convergence "
                          "rings and emit ring events for a sample of "
@@ -178,7 +198,10 @@ def main() -> int:
         no_retry=args.no_retry, slo=args.slo,
         slo_latency_target_s=args.slo_latency_target,
         flight_out=args.flight_out,
-        anomaly_baseline=args.anomaly_baseline)
+        anomaly_baseline=args.anomaly_baseline,
+        cost_out=args.cost_out,
+        profile_window_s=args.profile_window,
+        profile_dir=args.profile_dir)
     report["workload"] = args.workload
     print(json.dumps(report))
     # Under --chaos, errors are the scenario doing its job (failed

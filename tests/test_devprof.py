@@ -1,0 +1,438 @@
+"""Device-truth profiling plane: CostRecord warehouse, measured
+roofline, fusion-target attribution, and the gate rules (ISSUE 12
+acceptance)."""
+
+import json
+import os
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from porqua_tpu.obs import HarvestSink
+from porqua_tpu.obs.devprof import (
+    CostLog,
+    cost_record,
+    executable_cost,
+    executable_memory,
+    hlo_fingerprint,
+    load_cost_records,
+    roofline_verdict,
+    write_cost_records,
+)
+from porqua_tpu.obs.profile import qp_solve_profile
+from porqua_tpu.qp.canonical import CanonicalQP
+from porqua_tpu.qp.solve import (
+    SolverParams,
+    aot_compile_batch,
+    batch_shape_struct,
+)
+from porqua_tpu.serve.bucketing import Bucket, ExecutableCache
+
+_SCRIPTS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts")
+
+
+def make_qp(n=6, m=2, seed=0, dtype=np.float32):
+    rng = np.random.default_rng(seed)
+    A = rng.standard_normal((2 * n, n))
+    P = A.T @ A / (2 * n) + np.eye(n)
+    q = rng.standard_normal(n)
+    C = np.concatenate([np.ones((1, n)), rng.standard_normal((m - 1, n))])
+    return CanonicalQP.build(
+        P, q, C=C, l=np.full(m, -1.0), u=np.ones(m),
+        lb=np.zeros(n), ub=np.ones(n), dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# CostRecord schema + warehouse
+# ---------------------------------------------------------------------------
+
+class TestCostRecord:
+    def test_harvest_from_real_executable(self):
+        """A real compiled program yields real XLA numbers: flops and
+        bytes from cost_analysis, memory classes from memory_analysis,
+        and a stable HLO fingerprint."""
+        struct = jax.ShapeDtypeStruct((16, 16), jnp.float32)
+        compiled = jax.jit(lambda a: a @ a + 1.0).lower(struct).compile()
+        rec = cost_record(compiled, entry="probe", kind="test",
+                          bucket="16x16", slots=1, dtype="<f4",
+                          device="cpu:0", compile_s=0.5)
+        assert rec["v"] == 1 and rec["entry"] == "probe"
+        # One 16x16x16 matmul = 2*16^3 = 8192 flops, plus the add.
+        assert rec["flops"] >= 2 * 16 ** 3
+        assert rec["bytes_accessed"] > 0
+        assert rec["argument_bytes"] == 16 * 16 * 4
+        assert rec["output_bytes"] == 16 * 16 * 4
+        assert rec["peak_bytes"] > 0
+        assert len(rec["hlo_hash"]) == 16
+        # The fingerprint is a program identity: recompiling the SAME
+        # program reproduces it; a different program changes it.
+        again = jax.jit(lambda a: a @ a + 1.0).lower(struct).compile()
+        assert hlo_fingerprint(again) == rec["hlo_hash"]
+        other = jax.jit(lambda a: a @ a + 2.0).lower(struct).compile()
+        assert hlo_fingerprint(other) != rec["hlo_hash"]
+
+    def test_analysis_refusal_never_raises(self):
+        """A backend/object that refuses every analysis yields None
+        fields, not an exception — the compile path must not care."""
+        class Refuses:
+            def cost_analysis(self):
+                raise NotImplementedError
+
+            def memory_analysis(self):
+                raise NotImplementedError
+
+            def as_text(self):
+                raise NotImplementedError
+
+        assert executable_cost(Refuses()) == {"flops": None,
+                                              "bytes_accessed": None}
+        assert executable_memory(Refuses()) == {"peak_bytes": None}
+        rec = cost_record(Refuses(), entry="x", kind="y")
+        assert rec["flops"] is None and rec["hlo_hash"] is None
+
+    def test_jsonl_roundtrip(self, tmp_path):
+        rec = {"v": 1, "kind": "solve", "entry": "solve",
+               "bucket": "8x4", "slots": 2, "flops": 123.0,
+               "bytes_accessed": 456.0, "peak_bytes": 789.0}
+        for name in ("c.jsonl", "c.jsonl.gz"):
+            path = str(tmp_path / name)
+            with CostLog(path) as log:
+                log.emit(rec)
+                log.emit(dict(rec, slots=4))
+                assert log.records == 2 and log.write_failures == 0
+            back = load_cost_records(path)
+            assert len(back) == 2
+            assert back[0]["flops"] == 123.0 and back[1]["slots"] == 4
+
+    def test_dead_log_degrades_to_counters(self, tmp_path):
+        log = CostLog(str(tmp_path / "nodir" / "c.jsonl"))
+        assert log.write_failures == 1
+        log.emit({"v": 1})
+        assert log.records == 1  # counted, not raised
+        mem = CostLog()
+        mem.emit({"v": 1, "entry": "a"})
+        assert mem.buffered()[0]["entry"] == "a"
+        assert mem.counters() == {"cost_records": 1,
+                                  "cost_write_failures": 0}
+
+
+# ---------------------------------------------------------------------------
+# ExecutableCache harvesting + per-bucket exposition
+# ---------------------------------------------------------------------------
+
+class TestCacheHarvest:
+    def test_solve_and_continuous_entries_harvested(self, tmp_path):
+        path = str(tmp_path / "costs.jsonl")
+        params = SolverParams(max_iter=100, polish=False)
+        cache = ExecutableCache(params, cost_log=CostLog(path))
+        b = Bucket(8, 4)
+        cache.get(b, 2, np.float32)
+        cache.get_continuous(b, 2, np.float32)
+        cache.cost_log.close()
+        recs = load_cost_records(path)
+        # One record for the one-shot solve, three for the triple.
+        assert sorted((r["kind"], r["entry"]) for r in recs) == [
+            ("continuous", "admit"), ("continuous", "finalize"),
+            ("continuous", "step"), ("solve", "solve")]
+        for rec in recs:
+            assert rec["bucket"] == "8x4" and rec["slots"] == 2
+            assert rec["flops"] > 0 and rec["bytes_accessed"] > 0
+            assert rec["peak_bytes"] > 0 and rec["compile_s"] > 0
+        # In-process lookup sees the same records.
+        assert len(cache.cost_records()) == 4
+        solve_rec = cache.cost_record_for(b, 2, np.float32)
+        assert solve_rec["entry"] == "solve"
+        step_rec = cache.cost_record_for(b, 2, np.float32,
+                                         kind="continuous")
+        assert step_rec["entry"] == "step"
+        assert cache.cost_record_for(Bucket(16, 4), 2, np.float32) is None
+
+    def test_bucket_stats_and_gauges(self):
+        params = SolverParams(max_iter=100, polish=False)
+        cache = ExecutableCache(params)
+        b = Bucket(8, 4)
+        cache.get(b, 1, np.float32)
+        cache.get(b, 1, np.float32)  # hit
+        stats = cache.bucket_stats()["8x4"]
+        assert stats["compiles"] == 1 and stats["cache_hits"] == 1
+        assert stats["compile_seconds"] > 0
+        assert stats["peak_bytes_max"] > 0
+        gauges = cache.prometheus_gauges()
+        assert gauges["bucket_compiles_total"] == [({"bucket": "8x4"}, 1)]
+        assert gauges["bucket_cache_hits_total"] == [({"bucket": "8x4"}, 1)]
+        ((tag, peak),) = gauges["bucket_peak_bytes"]
+        assert tag == {"bucket": "8x4"} and peak > 0
+
+    def test_disabled_mode_harvests_nothing(self):
+        params = SolverParams(max_iter=100, polish=False)
+        cache = ExecutableCache(params, cost_log=False)
+        cache.get(Bucket(8, 4), 1, np.float32)
+        assert cache.cost_log is None
+        assert cache.cost_records() == []
+        # Cache-health stats still accumulate (they predate the plane).
+        assert cache.bucket_stats()["8x4"]["compiles"] == 1
+
+    def test_metrics_endpoint_carries_bucket_gauges(self):
+        from porqua_tpu.serve import BucketLadder, SolveService
+
+        params = SolverParams(max_iter=200, polish=False)
+        svc = SolveService(params=params,
+                           ladder=BucketLadder((8, 16), (4, 8)),
+                           max_batch=4)
+        with svc:
+            port = svc.start_http(0)
+            svc.solve(make_qp(seed=7), timeout=120)
+            text = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10
+            ).read().decode()
+            assert "# TYPE porqua_serve_bucket_compile_seconds_total " \
+                   "gauge" in text
+            assert 'porqua_serve_bucket_compiles_total{bucket="8x4"}' \
+                in text
+            assert 'porqua_serve_bucket_peak_bytes{bucket="8x4"}' in text
+            assert "porqua_serve_cost_records" in text
+            health = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10).read())
+            assert health["cache"]["executables"] >= 1
+            bstats = health["cache"]["buckets"]["8x4"]
+            assert bstats["compiles"] >= 1
+            assert bstats["peak_bytes_max"] > 0
+            assert health["cost_records"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# measured-vs-model reconciliation + identity pins
+# ---------------------------------------------------------------------------
+
+class TestMeasuredProfile:
+    def test_profile_switches_numerators_to_xla(self):
+        """On a known shape, a profile handed the executable's own
+        CostRecord reports XLA numerators with the analytic model side
+        by side — and the two agree on order of magnitude (the model
+        mirrors the real program; a 10x disagreement would mean one of
+        them is counting a different algorithm)."""
+        params = SolverParams(max_iter=100, polish=False)
+        B, n, m = 4, 16, 4
+        struct = batch_shape_struct(B, n, m)
+        compiled = aot_compile_batch(struct, params)
+        rec = cost_record(compiled, entry="solve", kind="solve",
+                          bucket=f"{n}x{m}", slots=B, dtype="<f4")
+        assert rec["flops"] > 0 and rec["bytes_accessed"] > 0
+        prof = qp_solve_profile(n, m, 50.0, 0.01, params=params,
+                                batch=B, cost=rec)
+        assert prof["cost_source"] == "xla"
+        assert prof["flops_est"] == rec["flops"]
+        assert prof["bytes_est"] == rec["bytes_accessed"]
+        assert prof["peak_bytes"] == rec["peak_bytes"]
+        assert prof["model_flops"] > 0 and prof["model_bytes"] > 0
+        # Achieved rates use the XLA numerators.
+        assert prof["achieved_tflops"] == pytest.approx(
+            rec["flops"] / 0.01 / 1e12)
+        # Drift is tracked, and bounded: model and compiler count the
+        # same program within two orders of magnitude on this tiny
+        # shape (XLA counts a full while_loop trip budget; the model
+        # counts executed iterations — the ratio is the tracked drift,
+        # not a hidden constant).
+        assert prof["flops_model_ratio"] > 0
+        assert prof["bytes_model_ratio"] > 0
+
+    def test_profile_without_cost_is_unchanged(self):
+        p = SolverParams(polish=False)
+        prof = qp_solve_profile(16, 4, 50.0, 0.01, params=p)
+        assert prof["cost_source"] == "model"
+        assert "flops_xla" not in prof and "model_flops" not in prof
+        assert prof["flops_est"] > 0 and prof["achieved_tflops"] > 0
+
+    def test_empty_cost_record_falls_back_to_model(self):
+        p = SolverParams(polish=False)
+        prof = qp_solve_profile(16, 4, 50.0, 0.01, params=p,
+                                cost={"flops": None,
+                                      "bytes_accessed": None})
+        assert prof["cost_source"] == "model"
+        assert prof["flops_est"] > 0
+
+    def test_gc107_devprof_identity_clean(self):
+        from porqua_tpu.analysis import contracts
+
+        assert contracts.check_devprof_identity() == []
+
+    def test_disabled_is_bit_identical(self):
+        """The acceptance pin: a service whose cache harvests cost
+        records returns byte-for-byte the arrays one with the plane
+        disabled does (harvesting reads compiled objects post-build;
+        the jaxpr half is contract GC107)."""
+        from porqua_tpu.serve import BucketLadder, SolveService
+
+        params = SolverParams(max_iter=300, polish=False)
+        qp = make_qp(seed=3)
+        results = []
+        for cost_log in (False, None):
+            svc = SolveService(params=params,
+                               ladder=BucketLadder((8, 16), (4, 8)),
+                               max_batch=4, warm_start=False,
+                               cost_log=cost_log)
+            with svc:
+                results.append(svc.solve(qp, timeout=120))
+        off, on = results
+        np.testing.assert_array_equal(np.asarray(off.x), np.asarray(on.x))
+        np.testing.assert_array_equal(np.asarray(off.iters),
+                                      np.asarray(on.iters))
+
+
+# ---------------------------------------------------------------------------
+# loadgen export + serve harvest records carry measured profiles
+# ---------------------------------------------------------------------------
+
+class TestLoadgenCostOut:
+    def test_cost_out_and_measured_harvest_profiles(self, tmp_path):
+        from porqua_tpu.serve.loadgen import (
+            build_tracking_requests, run_loadgen)
+
+        cost_path = str(tmp_path / "costs.jsonl")
+        harvest_path = str(tmp_path / "harvest.jsonl")
+        requests = build_tracking_requests(16, n_assets=8, window=32)
+        report = run_loadgen(requests, max_batch=8,
+                             harvest_out=harvest_path,
+                             cost_out=cost_path)
+        assert report["errors"] == 0
+        assert report["cost_out"] == cost_path
+        assert report["cost_records"] >= 1
+        summary = report["cost_summary"]
+        assert summary["executables"] == report["cost_records"]
+        assert summary["bytes_accessed_max"] > 0
+        assert summary["peak_bytes_max"] > 0
+        recs = load_cost_records(cost_path)
+        assert len(recs) == report["cost_records"]
+        assert all(r["kind"] == "solve" for r in recs)
+        # The serve harvest records switched their profile numerators
+        # to the executable's own cost analysis.
+        from porqua_tpu.obs import load_harvest
+
+        solves = load_harvest(harvest_path)
+        assert solves
+        for rec in solves:
+            prof = rec["profile"]
+            assert prof["cost_source"] == "xla"
+            assert prof["flops_xla"] > 0 and prof["bytes_xla"] > 0
+            assert prof["model_flops"] > 0
+            assert prof["peak_bytes"] > 0
+
+
+class TestFlightCostAttach:
+    def test_bundle_carries_implicated_bucket_costs(self):
+        from porqua_tpu.obs.flight import FlightRecorder
+
+        params = SolverParams(max_iter=100, polish=False)
+        cache = ExecutableCache(params)
+        cache.get(Bucket(8, 4), 1, np.float32)
+        cache.get(Bucket(16, 4), 1, np.float32)
+        flight = FlightRecorder(out_dir=None, debounce_s=0.0)
+        flight.attach(cache=cache)
+        bundle = flight.dump("dispatch_failure", bucket="8x4")
+        assert bundle["implicated_bucket"] == "8x4"
+        assert bundle["cost_records"]
+        assert all(r["bucket"] == "8x4" for r in bundle["cost_records"])
+        # A trigger naming no bucket gets the whole harvested set.
+        bundle2 = flight.dump("manual")
+        assert len(bundle2["cost_records"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# roofline verdict + gate rules
+# ---------------------------------------------------------------------------
+
+class TestRooflineVerdict:
+    def test_ranks_by_measured_bytes_and_joins_stages(self):
+        recs = [
+            {"kind": "continuous", "entry": "step", "bucket": "512x8",
+             "slots": 64, "dtype": "<f4", "device": "tpu:0",
+             "flops": 1e9, "bytes_accessed": 4e9, "peak_bytes": 1e9},
+            {"kind": "solve", "entry": "solve", "bucket": "32x8",
+             "slots": 8, "dtype": "<f4", "device": "tpu:0",
+             "flops": 1e7, "bytes_accessed": 2e7, "peak_bytes": 1e7},
+        ]
+        v = roofline_verdict(
+            recs, stage_seconds={"serve/segment_step": 1.5},
+            top=1, device_kind="TPU v5 lite")
+        assert v["executables"] == 2
+        assert v["ranked"][0]["entry"] == "step"
+        assert v["ranked"][0]["bound"] == "memory"
+        assert v["ranked"][0]["stage_seconds"] == {
+            "serve/segment_step": 1.5}
+        assert len(v["fusion_candidates"]) == 1
+        assert v["fusion_candidates"][0]["entry"] == "step"
+        assert "top fusion target: step" in v["verdict"]
+
+    def test_verdict_from_real_cache(self, tmp_path):
+        """End to end: compile through the real cache, export, verdict
+        — the acceptance path `bench/loadgen -> CostLog ->
+        roofline_report` without a synthetic record in sight."""
+        params = SolverParams(max_iter=100, polish=False)
+        cache = ExecutableCache(params)
+        cache.prewarm(Bucket(8, 4), 2, np.float32)
+        path = str(tmp_path / "c.jsonl")
+        write_cost_records(path, cache.cost_records())
+        v = roofline_verdict(load_cost_records(path), top=2)
+        assert v["executables"] == 2
+        assert v["fusion_candidates"]
+        assert v["ranked"][0]["bytes_accessed"] > 0
+
+    def test_selftest_passes(self):
+        sys.path.insert(0, _SCRIPTS)
+        try:
+            import roofline_report
+        finally:
+            sys.path.remove(_SCRIPTS)
+        assert roofline_report._selftest() == 0
+
+
+class TestGateCostRules:
+    @pytest.fixture()
+    def gate(self):
+        sys.path.insert(0, _SCRIPTS)
+        try:
+            import bench_gate
+        finally:
+            sys.path.remove(_SCRIPTS)
+        return bench_gate
+
+    def test_cost_drift_cells(self, gate):
+        base = gate._synthetic_baseline()
+        # Pass: identical cost numbers.
+        good = json.loads(json.dumps(base))
+        assert gate.check_payload(base, good)["ok"]
+        # Fail: flops drifted past the band (program changed).
+        bad = json.loads(json.dumps(base))
+        bad["xla_cost"]["flops"] *= 1.25
+        v = gate.check_payload(base, bad)
+        assert not v["ok"] and "xla_flops_drift" in v["failed"]
+        # Fail: serving peak memory grew past the band.
+        bad2 = json.loads(json.dumps(base))
+        bad2["config_serving"]["cost_summary"]["peak_bytes_max"] *= 1.3
+        v2 = gate.check_payload(base, bad2)
+        assert not v2["ok"] and "serving_peak_memory" in v2["failed"]
+        # Pass: peak memory SHRANK (one-sided rule).
+        better = json.loads(json.dumps(base))
+        better["xla_cost"]["peak_bytes"] *= 0.7
+        assert gate.check_payload(base, better)["ok"]
+        # Old baselines without xla_cost skip, not fail.
+        old = {k: v for k, v in base.items() if k != "xla_cost"}
+        old["config_serving"] = {
+            k: v for k, v in base["config_serving"].items()
+            if k != "cost_summary"}
+        v3 = gate.check_payload(old, good)
+        assert v3["ok"] and v3["n_skip"] >= 3
+        # A candidate that LOST the cost coverage fails (coverage
+        # regressions count — same posture as every other metric).
+        lossy = {k: v for k, v in good.items() if k != "xla_cost"}
+        v4 = gate.check_payload(base, lossy)
+        assert not v4["ok"] and "xla_flops_drift" in v4["failed"]
+
+    def test_selftest_covers_cost_rules(self, gate):
+        assert gate._selftest() == 0
