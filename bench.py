@@ -1460,11 +1460,16 @@ def main():
     # --cost-out PATH: the serving config exports its CostRecords
     # (XLA cost/memory analysis per compiled executable) as JSONL —
     # the scripts/roofline_report.py input.
+    # --ledger PATH: append one longitudinal run-ledger row (git rev +
+    # the key payload metrics) after the payload prints — the series
+    # scripts/trend_report.py renders and bench_gate --trend gates.
     _consume_path_flag("--trace-out", "PORQUA_BENCH_TRACE_OUT")
     _consume_path_flag("--harvest-out", "PORQUA_BENCH_HARVEST_OUT")
     _consume_path_flag("--profile-dir", "PORQUA_BENCH_PROFILE_DIR")
     _consume_value_flag("--profile-window", "PORQUA_BENCH_PROFILE_WINDOW")
     _consume_path_flag("--cost-out", "PORQUA_BENCH_COST_OUT")
+    _consume_path_flag("--ledger", "PORQUA_BENCH_LEDGER")
+    ledger_path = os.environ.pop("PORQUA_BENCH_LEDGER", None)
     if len(sys.argv) >= 3 and sys.argv[1] == "--device-child":
         device_child(sys.argv[2], int(sys.argv[3])
                      if len(sys.argv) > 3 else N_DATES)
@@ -1523,7 +1528,21 @@ def main():
         state["errors"].append(f"unexpected: {type(e).__name__}: {e}")
     finally:
         signal.alarm(0)
-        print(json.dumps(_assemble(state)), flush=True)
+        payload = _assemble(state)
+        print(json.dumps(payload), flush=True)
+        if ledger_path:
+            try:
+                from porqua_tpu.obs import ledger as _ledger
+
+                _ledger.append_row(ledger_path, _ledger.ledger_row(
+                    "bench", _ledger.metrics_from_bench(payload),
+                    rev=_ledger.git_rev(os.path.dirname(
+                        os.path.abspath(__file__)))))
+                log(f"ledger row appended to {ledger_path}")
+            except Exception as e:  # noqa: BLE001 - the payload is the
+                # artifact; a ledger append failure must not turn a
+                # finished benchmark into a nonzero exit.
+                log(f"ledger append failed: {type(e).__name__}: {e}")
 
 
 if __name__ == "__main__":

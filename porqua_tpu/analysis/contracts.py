@@ -535,6 +535,121 @@ def check_devprof_identity(dtype=np.float32) -> List[Finding]:
     return findings
 
 
+def check_federation_identity(dtype=np.float32) -> List[Finding]:
+    """GC108: the fleet federation plane must be invisible to XLA.
+
+    The federation plane (:mod:`porqua_tpu.obs.federation`,
+    :mod:`porqua_tpu.obs.vitals`, :mod:`porqua_tpu.obs.ledger`)
+    promises it is pure host file/dict bookkeeping: worker emitters
+    write JSONL, the collector merges counters and raw histograms,
+    liveness and vitals trends are float arithmetic — zero callbacks,
+    zero transfers, zero program edits on any jitted entry. This check
+    machine-verifies the enabled half of "disabled == bit-identical"
+    (the runtime half is pinned by ``tests/test_federation.py``): the
+    solve/serve entry points are traced bare, then the plane is
+    exercised FOR REAL — two worker streams written and drained, fleet
+    counters and raw histograms merged, the fleet SLO engine evaluated
+    on a stepped clock, a vitals leak trended to firing, one worker's
+    stream left to go stale so ``worker_lost`` fires and dumps a fleet
+    incident bundle through a real event-bus listener — and the entry
+    points are re-traced. The jaxprs must be string-identical, and the
+    probe self-verifies it actually exercised the plane (a collector
+    that never lost the worker or never dumped proves nothing).
+    """
+    import os
+    import tempfile
+
+    from porqua_tpu.obs.federation import FleetCollector, WorkerStream
+    from porqua_tpu.obs.flight import FlightRecorder
+    from porqua_tpu.obs.ledger import ledger_row, rolling_median
+    from porqua_tpu.obs.slo import SLOEngine, default_slos
+    from porqua_tpu.obs.vitals import VitalsTrend
+    from porqua_tpu.resilience.faults import FaultClock
+
+    def trace_all():
+        return [("solve_batch", str(solve_batch_jaxpr(dtype=dtype))),
+                ("serve_entry", str(serve_entry_jaxpr(dtype=dtype)))]
+
+    findings: List[Finding] = []
+    baseline = trace_all()
+
+    with tempfile.TemporaryDirectory() as td:
+        clock = FaultClock()
+        flight = FlightRecorder(out_dir=None, debounce_s=0.0,
+                                clock=clock)
+        engine = SLOEngine(default_slos(), clock=clock,
+                           min_eval_interval_s=0.0)
+        trend = VitalsTrend(min_samples=4, alpha_fast=0.6,
+                            alpha_slow=0.05)
+        collector = FleetCollector(
+            heartbeat_timeout_s=2.0, rollup_window_s=1.0,
+            slo=engine, flight=flight, vitals_trend=trend, clock=clock)
+        streams = {}
+        for wid in ("w0", "w1"):
+            path = os.path.join(td, f"{wid}.jsonl")
+            collector.add_worker(wid, path)
+            streams[wid] = WorkerStream(path, wid)
+            streams[wid].hello(latency_le=[0.01, 0.1])
+
+        def sample(completed, failed, counts, rss):
+            return dict(
+                slo={"completed": completed, "failed": failed,
+                     "expired": 0, "retry_giveups": 0,
+                     "validation_failures": 0,
+                     "latency_le": (0.01, 0.1),
+                     "latency_counts": tuple(counts),
+                     "latency_count": sum(counts)},
+                vitals={"rss_bytes": rss, "threads": 4})
+
+        streams["w0"].sample(**sample(5, 0, [3, 2, 0], 1000))
+        for i in range(8):
+            # w1 keeps heartbeating with a leaking RSS while w0 goes
+            # silent — the liveness deadline and the vitals trend both
+            # cross inside this loop.
+            clock.advance(1.0)
+            streams["w1"].sample(**sample(10 + i, 0, [6, 4, i],
+                                          1000 * (1.4 ** i)))
+            collector.drain()
+        merged = collector.slo_sample()
+        row = ledger_row("fleet_loadgen",
+                         {"fleet.completed": merged["completed"]})
+        med = rolling_median([row], "fleet.completed", window=3)
+    live = trace_all()
+
+    rows = {r["worker"]: r for r in collector.worker_rows()}
+    bundle_kinds = [b["trigger"]["kind"] for b in flight.bundles()]
+    if (not rows.get("w0", {}).get("status") == "lost"
+            or "worker_lost" not in bundle_kinds):
+        findings.append(Finding(
+            "GC108", "<jaxpr:federation_identity>", 0, 0,
+            "the federation probe never lost its stale worker or "
+            "never dumped the worker_lost bundle — the identity check "
+            "proved nothing"))
+    if merged["completed"] != 22 or merged["latency_counts"][0] != 9:
+        findings.append(Finding(
+            "GC108", "<jaxpr:federation_identity>", 0, 0,
+            "the collector merge produced wrong fleet counters — the "
+            "identity check exercised a broken plane"))
+    if trend.status()["fired"] < 1:
+        findings.append(Finding(
+            "GC108", "<jaxpr:federation_identity>", 0, 0,
+            "the vitals-trend probe never crossed its leak band — the "
+            "identity check proved nothing"))
+    if med != float(merged["completed"]):
+        findings.append(Finding(
+            "GC108", "<jaxpr:federation_identity>", 0, 0,
+            "the ledger probe did not round-trip its row — the "
+            "identity check exercised a broken plane"))
+    for (label, base), (_, lv) in zip(baseline, live):
+        if base != lv:
+            findings.append(Finding(
+                "GC108", f"<jaxpr:{label}>", 0, 0,
+                "traced program differs with the fleet federation "
+                "plane active: the plane is no longer invisible to "
+                "XLA (disabled-bit-identity contract broken)"))
+    return findings
+
+
 def run_batch_jaxpr(bs, params=None, dtype=np.float32) -> ClosedJaxpr:
     """Trace ``run_batch``'s device core against a *real*
     ``BacktestService``: the host pass (``build_problems``) runs for
@@ -628,4 +743,11 @@ def check_entry_points(dtype=np.float32,
     # solve/serve programs string-identical (the plane reads compiled
     # objects, never traced ones).
     findings += check_devprof_identity(dtype=dtype)
+    # GC108: and for the fleet federation plane — worker streams
+    # written and drained, counters/raw-histograms merged, a worker
+    # lost to the liveness deadline, a fleet incident bundle dumped,
+    # a vitals leak trended to firing, a ledger row round-tripped —
+    # all of it must leave the traced solve/serve programs string-
+    # identical (the plane is host file/dict code end to end).
+    findings += check_federation_identity(dtype=dtype)
     return findings

@@ -38,6 +38,17 @@ measured figures where available, and ``roofline_verdict`` /
 into the fusion-candidate verdict — contract GC107 pins the plane
 invisible to XLA.
 
+The **fleet federation plane** (README "Fleet observability & soak
+testing") scales all of it past one process:
+:mod:`porqua_tpu.obs.federation` (per-worker ``WorkerStream`` JSONL
+emitters drained by a ``FleetCollector`` that merges counters and RAW
+latency histograms, runs fleet SLOs through the same ``SLOEngine``,
+tracks worker liveness into ``worker_lost`` incidents, and keeps
+bounded soak rollups), :mod:`porqua_tpu.obs.vitals` (process vitals +
+EWMA leak trending), and :mod:`porqua_tpu.obs.ledger` (the
+longitudinal run ledger ``bench_gate --trend`` gates against) —
+contract GC108 pins the whole plane invisible to XLA.
+
 :class:`Observability` bundles one span recorder and one event bus;
 pass it to ``SolveService(obs=...)`` and every layer (batcher,
 executable cache, device health) records through it. The package is
@@ -55,6 +66,7 @@ from porqua_tpu.obs.devprof import (
 )
 from porqua_tpu.obs.events import EventBus, load_jsonl
 from porqua_tpu.obs.exposition import ObsHTTPServer, prometheus_text
+from porqua_tpu.obs.federation import FleetCollector, WorkerStream
 from porqua_tpu.obs.flight import FlightRecorder, load_bundle
 from porqua_tpu.obs.harvest import (
     HarvestSink,
@@ -62,11 +74,18 @@ from porqua_tpu.obs.harvest import (
     load_harvest,
     solve_record,
 )
+from porqua_tpu.obs.ledger import (
+    append_row,
+    ledger_row,
+    load_ledger,
+    rolling_median,
+)
 from porqua_tpu.obs.profile import StageProfiler, qp_solve_profile
 from porqua_tpu.obs.report import render_report
 from porqua_tpu.obs.rings import ring_history, solution_ring_history
 from porqua_tpu.obs.slo import SLO, BurnRateRule, SLOEngine, default_slos
 from porqua_tpu.obs.trace import Span, SpanRecorder
+from porqua_tpu.obs.vitals import VitalsTrend, process_vitals
 
 
 class Observability:
@@ -91,6 +110,7 @@ __all__ = [
     "BurnRateRule",
     "CostLog",
     "EventBus",
+    "FleetCollector",
     "FlightRecorder",
     "HarvestSink",
     "Observability",
@@ -101,17 +121,24 @@ __all__ = [
     "Span",
     "SpanRecorder",
     "StageProfiler",
+    "VitalsTrend",
+    "WorkerStream",
+    "append_row",
     "cost_record",
     "default_slos",
     "harvest_solution",
+    "ledger_row",
     "load_bundle",
     "load_cost_records",
     "load_harvest",
     "load_jsonl",
+    "load_ledger",
+    "process_vitals",
     "prometheus_text",
     "qp_solve_profile",
     "render_report",
     "ring_history",
+    "rolling_median",
     "roofline_verdict",
     "solution_ring_history",
     "solve_record",

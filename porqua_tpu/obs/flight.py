@@ -53,8 +53,12 @@ BUNDLE_VERSION = 1
 
 #: Event kinds that open an incident (the trigger inventory — README
 #: "SLOs, alerting & incident response" documents each). Stateful
-#: kinds (``slo_alert``, ``convergence_anomaly``) trigger only when
-#: their ``state`` field is ``firing``.
+#: kinds (``slo_alert``, ``convergence_anomaly``, ``vitals_anomaly``)
+#: trigger only when their ``state`` field is ``firing``.
+#: ``worker_lost`` and ``vitals_anomaly`` come from the fleet plane
+#: (:mod:`porqua_tpu.obs.federation` / :mod:`porqua_tpu.obs.vitals`):
+#: a crashed loadgen shard or a leaking worker must land an incident
+#: bundle, not a silent throughput dip.
 DEFAULT_TRIGGERS = (
     "breaker_open",
     "retry_giveup",
@@ -63,11 +67,14 @@ DEFAULT_TRIGGERS = (
     "harvest_sink_failed",
     "slo_alert",
     "convergence_anomaly",
+    "worker_lost",
+    "vitals_anomaly",
 )
 
 #: Kinds whose events carry an alert ``state`` — only the firing edge
 #: is an incident.
-_STATEFUL_TRIGGERS = ("slo_alert", "convergence_anomaly")
+_STATEFUL_TRIGGERS = ("slo_alert", "convergence_anomaly",
+                      "vitals_anomaly")
 
 #: Event kinds folded into the bundle's per-device breaker history.
 _BREAKER_KINDS = ("breaker_open", "breaker_close", "probe_failure")

@@ -425,6 +425,74 @@ def slo_section(events: Sequence[Dict[str, Any]],
     return "\n".join(lines)
 
 
+def fleet_section(report: Dict[str, Any]) -> str:
+    """The fleet view of a ``scripts/fleet_loadgen.py`` run: the
+    per-worker throughput/latency table, the reconciliation verdict,
+    the worker-liveness verdict line, the bounded-rollup throughput
+    sparkline, and the fleet SLO/alert summary. (Pair with
+    ``--events`` on the fleet event log for the full chronological
+    SLO/alert timeline — :func:`slo_section` renders it.)"""
+    rows = report.get("rows") or []
+    lines = [f"fleet workers ({len(rows)})"]
+    lines.append(f"  {'worker':<8} {'status':<8} {'completed':>10} "
+                 f"{'failed':>7} {'thr/s':>9} {'p50 ms':>8} "
+                 f"{'p99 ms':>8} {'recomp':>7} {'rss MB':>8}")
+    for r in rows:
+        vit = r.get("vitals") or {}
+        rss = vit.get("rss_bytes")
+        lines.append(
+            f"  {r.get('worker', '?'):<8} {r.get('status', '?'):<8} "
+            f"{r.get('completed', 0):>10} {r.get('failed', 0):>7} "
+            f"{r.get('throughput_solves_per_s', 0.0):>9.1f} "
+            f"{r.get('latency_p50_ms', 0.0):>8.2f} "
+            f"{r.get('latency_p99_ms', 0.0):>8.2f} "
+            f"{r.get('recompiles_after_warmup', 0):>7} "
+            f"{(rss / 1e6 if rss else 0.0):>8.1f}")
+    fleet = report.get("fleet") or {}
+    lines.append(
+        f"  fleet: {fleet.get('completed', 0)} completed, "
+        f"{fleet.get('failed', 0)} failed, "
+        f"{fleet.get('throughput_solves_per_s', 0.0):.1f}/s merged, "
+        f"harvest {fleet.get('harvest_records')}")
+    recon = report.get("reconciliation") or {}
+    lines.append(
+        ("  reconciliation: OK — fleet completed == sum(worker "
+         "completed) == merged harvest records")
+        if report.get("reconciled") else
+        f"  reconciliation: !! MISMATCH {recon}")
+    lost = report.get("workers_lost") or []
+    n_ok = sum(1 for r in rows if r.get("status") != "lost")
+    lines.append(
+        f"  worker liveness: {n_ok} ok, {len(lost)} lost"
+        + (f" — LOST: {', '.join(lost)} "
+           f"({report.get('worker_lost_bundles', 0)} worker_lost "
+           f"incident bundle(s) dumped)" if lost else " — all alive"))
+    roll = report.get("rollups_tail") or []
+    if roll:
+        spark = sparkline([float(r.get("completed", 0)) for r in roll],
+                          width=min(len(roll) * 2, 32))
+        lines.append(
+            f"  rollups (last {len(roll)} x "
+            f"{roll[-1].get('span_s', 0):g}s windows) completed/window: "
+            f"{spark}  [{report.get('rollup_windows', len(roll))} "
+            f"windows total, ring-bounded]")
+    slo = report.get("slo")
+    if slo:
+        firing = slo.get("firing") or []
+        compl = ", ".join(
+            f"{name} {entry.get('compliance', 1.0):.4f}"
+            for name, entry in sorted(slo.get("slos", {}).items()))
+        lines.append(
+            f"  fleet slo: {compl}; alerts fired "
+            f"{slo.get('alerts_fired', 0)}"
+            + (f"; !! FIRING: {', '.join(firing)}" if firing
+               else "; none firing"))
+    if report.get("vitals_anomalous"):
+        lines.append("  vitals: !! trending "
+                     + ", ".join(report["vitals_anomalous"]))
+    return "\n".join(lines)
+
+
 def events_section(events: Sequence[Dict[str, Any]],
                    max_shown: int = 12) -> str:
     """Severity rollup + the most recent warn/error lines."""
@@ -449,9 +517,12 @@ def render_report(trace: Any = None,
                   events: Optional[Sequence[Dict[str, Any]]] = None,
                   snapshot: Optional[Dict[str, Any]] = None,
                   harvest: Optional[Sequence[Dict[str, Any]]] = None,
-                  costs: Optional[Sequence[Dict[str, Any]]] = None) -> str:
+                  costs: Optional[Sequence[Dict[str, Any]]] = None,
+                  fleet: Optional[Dict[str, Any]] = None) -> str:
     """The full text report from whichever artifacts exist."""
     sections = []
+    if fleet is not None:
+        sections.append(fleet_section(fleet))
     if snapshot is not None:
         sections.append(latency_section(snapshot))
     if trace is not None:
@@ -466,7 +537,7 @@ def render_report(trace: Any = None,
     if costs is not None:
         sections.append(costs_section(costs, harvest=harvest))
     if not sections:
-        return ("obs_report: no artifacts given "
-                "(need --trace/--events/--metrics/--harvest/--costs)")
+        return ("obs_report: no artifacts given (need --trace/--events"
+                "/--metrics/--harvest/--costs/--fleet)")
     rule = "-" * 64
     return f"\n{rule}\n".join(sections)

@@ -115,9 +115,14 @@ SEAMS = (
     "cache.get",
     "data.feed",
     "backtest.chunk",
+    "loadgen.worker",
 )
 
 #: kind -> seams it is allowed to target (the DSL's type system).
+#: ``crash`` additionally targets ``loadgen.worker``: a fleet loadgen
+#: shard (scripts/fleet_loadgen.py) dies mid-soak through the same
+#: seeded kind the crash-resume backtests use — the fleet collector's
+#: liveness tracking must turn that into a ``worker_lost`` incident.
 KINDS: Dict[str, Tuple[str, ...]] = {
     "device_lost": ("serve.dispatch", "serve.continuous"),
     "probe_fail": ("health.probe",),
@@ -126,7 +131,7 @@ KINDS: Dict[str, Tuple[str, ...]] = {
     "queue_stall": ("serve.admission",),
     "clock_skew": ("serve.admission",),
     "feed_corrupt": ("data.feed",),
-    "crash": ("backtest.chunk",),
+    "crash": ("backtest.chunk", "loadgen.worker"),
 }
 
 

@@ -445,19 +445,35 @@ class SolveService:
                     self.snapshot(),
                     histograms=self.metrics.histograms(),
                     extra_counters=self._obs_counters(),
-                    extra_gauges=self._slo_gauges(),
+                    extra_gauges=self._extra_gauges(),
                     labeled_gauges=self.cache.prometheus_gauges()),
                 health_fn=self._health_payload, host=host, port=port)
         return self._http.start()
 
-    def _slo_gauges(self) -> Optional[dict]:
-        """Fresh SLO burn-rate / alert-state / compliance gauges for
-        the scrape (an evaluation runs first, clock-gated, so an idle
-        service's burn rates still decay between requests)."""
-        if self.slo is None:
-            return None
-        self.slo.maybe_evaluate()
-        return self.slo.gauges()
+    def _extra_gauges(self) -> dict:
+        """Scrape-time gauge set: SLO burn rates/alert states (an
+        evaluation runs first, clock-gated, so an idle service's burn
+        rates still decay between requests) + process vitals (RSS,
+        open fds, threads, submission-queue depth — the signals the
+        soak leak detector watches, exported here so a lone
+        serve_loadgen run surfaces the same series as a fleet
+        worker)."""
+        out: dict = {}
+        if self.slo is not None:
+            self.slo.maybe_evaluate()
+            out.update(self.slo.gauges())
+        for key, value in self.vitals().items():
+            if key != "t":
+                out[f"vitals_{key}"] = value
+        return out
+
+    def vitals(self) -> dict:
+        """One :func:`porqua_tpu.obs.vitals.process_vitals` sample for
+        this serving process, queue depth included (sampled at call
+        time — scrape-time only, nothing on the request path)."""
+        from porqua_tpu.obs.vitals import process_vitals
+
+        return process_vitals(queue_depth=self.batcher.queue.qsize())
 
     def _obs_counters(self) -> dict:
         """Observability-plane health counters that live OUTSIDE the
@@ -505,6 +521,10 @@ class SolveService:
                 "executables": len(self.cache),
                 "buckets": self.cache.bucket_stats(),
             },
+            # Process vitals: the leak-shaped signals (RSS, fds,
+            # threads, queue depth) a soak driver — or a human on a
+            # long-running instance — reads without scraping.
+            "vitals": self.vitals(),
         }
         if self.slo is not None:
             # SLO status from one endpoint: per-SLO compliance, the

@@ -34,13 +34,27 @@ newer payload parts — BENCH_r05 has no ``config_serving``); a metric
 the baseline HAS but the candidate lost is a failure (coverage
 regressions count as regressions). ``--selftest`` builds a synthetic
 baseline + a passing and a regressed candidate and asserts both
-verdicts — the cheap CI smoke ``scripts/run_tests.sh`` runs.
+verdicts (single-baseline AND trend cells) — the cheap CI smoke
+``scripts/run_tests.sh`` runs.
+
+**Trend gating** (``--trend LEDGER.jsonl``): instead of one committed
+baseline, the candidate is gated against the **rolling median of the
+last K ledger rows** (:mod:`porqua_tpu.obs.ledger`; K =
+``--trend-window``, rows filtered to ``--trend-kind``). The same RULES
+table applies — the rolling median simply becomes the baseline value
+per metric — which closes the slow-drift hole a pairwise diff leaves
+open: three consecutive PRs each 20% slower pass pairwise gates but
+fail against the median of the window that remembers the fast runs.
+``--append-ledger`` records the gated payload + verdict as a new
+ledger row, so gating maintains the very series it gates against.
 
 Examples::
 
     python bench.py > /tmp/bench_fresh.json
     python scripts/bench_gate.py --baseline BENCH_r05.json \\
         --payload /tmp/bench_fresh.json --out gate_verdict.json
+    python scripts/bench_gate.py --trend LEDGER.jsonl \\
+        --payload /tmp/bench_fresh.json --append-ledger
 """
 
 from __future__ import annotations
@@ -231,8 +245,71 @@ def check_payload(baseline: Dict[str, Any],
     }
 
 
+def trend_baseline(rows: List[Dict[str, Any]],
+                   window: int = 5,
+                   kind: Optional[str] = "bench") -> Dict[str, Any]:
+    """Build a baseline payload from ledger rows: per metric, the
+    rolling median over the last ``window`` rows (of ``kind``),
+    re-nested into the payload shape the RULES table looks up. Metric
+    NAMES come from that same window — a metric only older rows carry
+    (renamed, retired) ages out of the baseline instead of failing
+    every future run as a coverage regression. A metric no recent row
+    carries is simply absent — its rules skip, exactly like gating
+    against an old artifact."""
+    from porqua_tpu.obs import ledger
+
+    recent = [r for r in rows
+              if kind is None or r.get("kind") == kind][-int(window):]
+    metrics: List[str] = []
+    for r in recent:
+        for k in (r.get("metrics") or {}):
+            if k not in metrics:
+                metrics.append(k)
+    flat: Dict[str, Any] = {}
+    for metric in metrics:
+        med = ledger.rolling_median(recent, metric, window=window,
+                                    kind=kind)
+        if med is not None:
+            flat[metric] = med
+    return ledger.nest_metrics(flat)
+
+
+def check_trend(ledger_path: str,
+                candidate: Dict[str, Any],
+                window: int = 5,
+                kind: Optional[str] = "bench",
+                tolerance_scale: float = 1.0) -> Dict[str, Any]:
+    """Gate ``candidate`` against the ledger's rolling medians; the
+    verdict carries a ``trend`` section naming the window it used."""
+    from porqua_tpu.obs import ledger
+
+    rows = ledger.load_ledger(ledger_path)
+    kind_rows = [r for r in rows
+                 if kind is None or r.get("kind") == kind]
+    baseline = trend_baseline(rows, window=window, kind=kind)
+    verdict = check_payload(baseline, candidate,
+                            tolerance_scale=tolerance_scale)
+    verdict["trend"] = {
+        "ledger": ledger_path,
+        "window": int(window),
+        "kind": kind,
+        "rows_total": len(rows),
+        "rows_of_kind": len(kind_rows),
+        "baseline_metrics": sum(
+            1 for c in verdict["checks"] if c["baseline"] is not None),
+    }
+    return verdict
+
+
 def render_verdict(verdict: Dict[str, Any]) -> str:
     lines = []
+    trend = verdict.get("trend")
+    if trend:
+        lines.append(
+            f"trend gate: rolling median of last {trend['window']} "
+            f"{trend['kind'] or 'any'} rows "
+            f"({trend['rows_of_kind']}/{trend['rows_total']} ledger "
+            f"rows, {trend['ledger']})")
     for c in verdict["checks"]:
         mark = {"pass": "OK  ", "fail": "FAIL", "skip": "skip"}[c["status"]]
         detail = ""
@@ -329,6 +406,47 @@ def _selftest() -> int:
     assert not v_lossy["ok"] and "serving_throughput" in v_lossy["failed"], \
         v_lossy["failed"]
 
+    # Trend cells: the SAME rule table gating against the rolling
+    # median of a synthetic ledger. A candidate hovering at the
+    # median passes; the slow-drift case — each run a bit slower, the
+    # last one well under the window's median — fails exactly the
+    # ratio rules (and an invariant break fails regardless of the
+    # window's history).
+    import tempfile
+
+    from porqua_tpu.obs import ledger as _ledger
+
+    with tempfile.TemporaryDirectory() as td:
+        lpath = os.path.join(td, "LEDGER.jsonl")
+        for i, scale in enumerate((1.02, 1.0, 0.99, 1.01, 1.0)):
+            row_payload = json.loads(json.dumps(base))
+            row_payload["vs_baseline"] *= scale
+            row_payload["config_serving"]["throughput_solves_per_s"] *= scale
+            _ledger.append_row(lpath, _ledger.ledger_row(
+                "bench", _ledger.metrics_from_bench(row_payload),
+                run_id=f"selftest-r{i}", t=float(i)))
+        v_trend_good = check_trend(lpath, good, window=5)
+        assert v_trend_good["ok"], \
+            f"selftest: trend-clean payload failed: {v_trend_good['failed']}"
+        assert v_trend_good["trend"]["rows_of_kind"] == 5, v_trend_good
+        drifted = json.loads(json.dumps(base))
+        drifted["vs_baseline"] *= 0.55                   # under 0.7x median
+        drifted["config_serving"]["throughput_solves_per_s"] *= 0.5
+        drifted["config_serving"]["recompiles_after_warmup"] = 1
+        v_trend_bad = check_trend(lpath, drifted, window=5)
+        assert not v_trend_bad["ok"], "selftest: trend-drifted passed"
+        for name in ("headline_speedup", "serving_throughput",
+                     "serving_recompiles"):
+            assert name in v_trend_bad["failed"], \
+                f"selftest: {name} not in {v_trend_bad['failed']}"
+        # An empty ledger gates nothing: every baseline rule skips,
+        # the invariants still apply.
+        empty = os.path.join(td, "EMPTY.jsonl")
+        v_empty = check_trend(empty, good, window=5)
+        assert v_empty["ok"] and v_empty["n_skip"] > 0, v_empty
+        assert render_verdict(v_trend_bad).startswith("trend gate:"), \
+            render_verdict(v_trend_bad).splitlines()[0]
+
     # The committed r05 artifact itself must gate clean against a
     # candidate equal to it (wrapper form exercised via load_payload).
     r05 = os.path.join(os.path.dirname(os.path.dirname(
@@ -356,17 +474,36 @@ def main() -> int:
     ap.add_argument("--tolerance-scale", type=float, default=1.0,
                     help="scale every ratio/band tolerance (0.5 = "
                          "twice as strict; invariants are never scaled)")
+    ap.add_argument("--trend", default=None, metavar="LEDGER",
+                    help="gate against the rolling median of the last "
+                         "--trend-window ledger rows instead of a "
+                         "single --baseline artifact")
+    ap.add_argument("--trend-window", type=int, default=5,
+                    help="rolling-median window (default 5 rows)")
+    ap.add_argument("--trend-kind", default="bench",
+                    help="ledger row kind the window draws from "
+                         "(default bench; 'any' disables the filter)")
+    ap.add_argument("--append-ledger", action="store_true",
+                    help="with --trend: append the gated payload + "
+                         "verdict as a new ledger row (the gate then "
+                         "maintains the series it gates against)")
     ap.add_argument("--selftest", action="store_true",
                     help="synthetic baseline vs passing + regressed "
-                         "payloads; asserts both verdicts")
+                         "payloads (single-baseline AND trend cells); "
+                         "asserts both verdicts")
     args = ap.parse_args()
 
     if args.selftest:
         return _selftest()
-    if not args.baseline or not args.payload:
-        ap.error("--baseline and --payload are required (or --selftest)")
+    if args.baseline and args.trend:
+        ap.error("--baseline and --trend are mutually exclusive "
+                 "(one gate, one baseline definition)")
+    if not (args.baseline or args.trend) or not args.payload:
+        ap.error("--payload plus --baseline or --trend are required "
+                 "(or --selftest)")
+    if args.append_ledger and not args.trend:
+        ap.error("--append-ledger requires --trend (it names the ledger)")
 
-    baseline = load_payload(args.baseline)
     if args.payload == "-":
         candidate = json.loads(sys.stdin.read())
         if "parsed" in candidate and isinstance(candidate["parsed"], dict):
@@ -374,15 +511,43 @@ def main() -> int:
     else:
         candidate = load_payload(args.payload)
 
-    verdict = check_payload(baseline, candidate,
-                            tolerance_scale=args.tolerance_scale)
-    verdict["baseline_path"] = args.baseline
+    if args.trend:
+        kind = None if args.trend_kind == "any" else args.trend_kind
+        verdict = check_trend(args.trend, candidate,
+                              window=args.trend_window, kind=kind,
+                              tolerance_scale=args.tolerance_scale)
+    else:
+        baseline = load_payload(args.baseline)
+        verdict = check_payload(baseline, candidate,
+                                tolerance_scale=args.tolerance_scale)
+        verdict["baseline_path"] = args.baseline
     verdict["payload_path"] = args.payload
     print(render_verdict(verdict))
     if args.out:
         with open(args.out, "w") as f:
             json.dump(verdict, f, indent=1)
         print(f"verdict written to {args.out}")
+    if args.append_ledger:
+        from porqua_tpu.obs import ledger as _ledger
+
+        # The extractor must match the payload's kind — the bench
+        # paths (vs_baseline, config_serving.*) don't exist in a
+        # loadgen/fleet report, and an empty-metrics row would starve
+        # the very series --append-ledger exists to maintain.
+        row_kind = (args.trend_kind if args.trend_kind in _ledger.KINDS
+                    else "bench")
+        extract = {
+            "bench": _ledger.metrics_from_bench,
+            "serve_loadgen": _ledger.metrics_from_loadgen,
+            "fleet_loadgen": _ledger.metrics_from_fleet,
+        }[row_kind]
+        row = _ledger.ledger_row(
+            row_kind, extract(candidate),
+            rev=_ledger.git_rev(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))),
+            gate=verdict, artifact=args.payload)
+        _ledger.append_row(args.trend, row)
+        print(f"ledger row {row['run_id']} appended to {args.trend}")
     return 0 if verdict["ok"] else 1
 
 

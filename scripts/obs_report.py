@@ -21,6 +21,12 @@ Consumes the artifacts a traced run emits and prints one text report:
   device memory, XLA-measured bytes per executable, and — joined with
   ``--harvest`` — the measured-vs-model MFU table. The fusion-target
   ranking: ``scripts/roofline_report.py``.
+* ``--fleet fleet_report.json`` — a merged fleet report
+  (``scripts/fleet_loadgen.py --out``): per-worker throughput/latency
+  table, reconciliation + worker-liveness verdict lines, bounded-
+  rollup sparkline, and the fleet SLO/alert summary. Pair with
+  ``--events`` on the fleet event log for the chronological SLO/alert
+  timeline.
 
 ``--selftest`` builds a synthetic run in-process (no JAX, no service)
 and checks the rendering pipeline end to end — the cheap CI smoke
@@ -183,9 +189,54 @@ def _selftest() -> int:
                     "bytes_est": 6.5e8, "model_flops": 5.0e8,
                     "model_bytes": 5.2e8, "flops_model_ratio": 1.19,
                     "bytes_model_ratio": 0.8, "peak_bytes": 4.2e7}})
+    # A synthetic merged fleet report (the fleet_loadgen.py --out
+    # shape): one lost worker with its worker_lost bundle, exact
+    # reconciliation over the survivors, rollup tail, SLO summary.
+    fleet = {
+        "workers": 3,
+        "workers_lost": ["w2"],
+        "worker_lost_bundles": 1,
+        "reconciled": True,
+        "reconciliation": {"completed_sample_equals_rows": True,
+                           "harvest_equals_completed": True},
+        "rows": [
+            {"worker": "w0", "status": "ok", "completed": 1200,
+             "failed": 0, "throughput_solves_per_s": 240.0,
+             "latency_p50_ms": 4.1, "latency_p99_ms": 9.8,
+             "recompiles_after_warmup": 0,
+             "vitals": {"rss_bytes": 512e6, "open_fds": 40,
+                        "threads": 12, "queue_depth": 3}},
+            {"worker": "w1", "status": "ok", "completed": 1180,
+             "failed": 2, "throughput_solves_per_s": 236.0,
+             "latency_p50_ms": 4.3, "latency_p99_ms": 11.2,
+             "recompiles_after_warmup": 0,
+             "vitals": {"rss_bytes": 530e6}},
+            {"worker": "w2", "status": "lost", "completed": 400,
+             "failed": 0},
+        ],
+        "fleet": {"completed": 2780, "failed": 2,
+                  "harvest_records": 2380,
+                  "throughput_solves_per_s": 556.0},
+        "rollups_tail": [{"completed": 450 + 10 * i, "span_s": 30.0}
+                         for i in range(6)],
+        "rollup_windows": 20,
+        "slo": {"slos": {"availability": {"compliance": 0.9993},
+                         "latency": {"compliance": 0.991}},
+                "firing": [], "alerts_fired": 1},
+        "vitals_anomalous": ["w1/rss_bytes"],
+    }
     text = render_report(trace=trace, events=events, snapshot=snapshot,
-                         harvest=harvest, costs=costs)
-    for needle in ("stage waterfall", "queue_wait", "span coverage",
+                         harvest=harvest, costs=costs, fleet=fleet)
+    for needle in ("fleet workers (3)",
+                   "worker liveness: 2 ok, 1 lost",
+                   "LOST: w2",
+                   "1 worker_lost incident bundle",
+                   "reconciliation: OK",
+                   "rollups (last 6 x 30s windows)",
+                   "fleet slo: availability 0.9993",
+                   "alerts fired 1",
+                   "vitals: !! trending w1/rss_bytes",
+                   "stage waterfall", "queue_wait", "span coverage",
                    "convergence rings", "breaker_open",
                    "latency / throughput", "faults / recovery",
                    "injected serve.dispatch", "retry_scheduled",
@@ -227,6 +278,10 @@ def main() -> int:
                     help="device-truth CostRecord dataset (CostLog "
                          "JSONL/.gz, serve_loadgen --cost-out): "
                          "device cost/memory section")
+    ap.add_argument("--fleet", default=None,
+                    help="merged fleet report JSON (fleet_loadgen "
+                         "--out): per-worker table, reconciliation + "
+                         "liveness verdicts, SLO summary")
     ap.add_argument("--selftest", action="store_true",
                     help="render a synthetic run and verify the pipeline")
     args = ap.parse_args()
@@ -237,7 +292,10 @@ def main() -> int:
     from porqua_tpu.obs import (
         load_cost_records, load_harvest, load_jsonl, render_report)
 
-    trace = events = snapshot = harvest = costs = None
+    trace = events = snapshot = harvest = costs = fleet = None
+    if args.fleet:
+        with open(args.fleet) as f:
+            fleet = json.load(f)
     if args.trace:
         with open(args.trace) as f:
             trace = json.load(f)
@@ -252,7 +310,7 @@ def main() -> int:
         costs = load_cost_records(args.costs)
 
     print(render_report(trace=trace, events=events, snapshot=snapshot,
-                        harvest=harvest, costs=costs))
+                        harvest=harvest, costs=costs, fleet=fleet))
     return 0
 
 

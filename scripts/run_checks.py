@@ -6,9 +6,11 @@ Usage:
 
 Defaults to scanning ``porqua_tpu/`` — every package subtree,
 including the observability stack ``porqua_tpu/obs/`` (the telemetry
-warehouse ``obs/harvest.py``, stage profiler ``obs/profile.py``, and
-the live operational plane ``obs/slo.py`` / ``obs/flight.py`` /
-``obs/anomaly.py`` among it), the compaction driver
+warehouse ``obs/harvest.py``, stage profiler ``obs/profile.py``, the
+live operational plane ``obs/slo.py`` / ``obs/flight.py`` /
+``obs/anomaly.py``, and the fleet federation plane
+``obs/federation.py`` / ``obs/vitals.py`` / ``obs/ledger.py`` among
+it), the compaction driver
 ``porqua_tpu/compaction.py``, the continuous batcher
 ``porqua_tpu/serve/continuous.py``, and the resilience plane
 ``porqua_tpu/resilience/`` (all of which must scan
@@ -38,8 +40,13 @@ identical), and the GC107 devprof-identity contract (a real AOT
 compile harvested into a CostRecord through a live CostLog plus a
 measured qp_solve_profile leave the solve/serve jaxprs string-
 identical — the device-truth cost plane reads compiled objects,
-never traced ones). Exit status: 0 clean, 1 findings, 2
-internal/usage error.
+never traced ones), and the GC108 federation-identity contract (the
+fleet plane fully exercised — worker streams drained, counters and
+raw histograms merged, a worker lost to the liveness deadline with
+its incident bundle dumped, a vitals leak trended to firing, a
+ledger row round-tripped — leaves the solve/serve jaxprs string-
+identical: the whole fleet observability plane is host file/dict
+code). Exit status: 0 clean, 1 findings, 2 internal/usage error.
 
 Options:
     --format {text,json}   output format (default text)
@@ -112,7 +119,8 @@ def main(argv=None) -> int:
 
     if not args.no_contracts and (
             rules is None or rules & {"GC101", "GC102", "GC103", "GC104",
-                                      "GC105", "GC106", "GC107"}):
+                                      "GC105", "GC106", "GC107",
+                                      "GC108"}):
         try:
             import jax
 

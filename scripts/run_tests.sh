@@ -70,6 +70,30 @@ else
     fail=1
 fi
 
+# fleet_loadgen: the federation plane — a no-JAX collector unit pass
+# (merge / reconciliation / liveness / rollup bounds / namespacing /
+# ladder refusal) plus a real 2-worker ~10 s mini-soak on XLA-CPU
+# whose merged report must reconcile exactly with 0 recompiles and 0
+# lost workers (README "Fleet observability & soak testing").
+if out=$(timeout 900 env JAX_PLATFORMS=cpu python scripts/fleet_loadgen.py --selftest 2>&1); then
+    echo "OK   fleet_loadgen --selftest: $(echo "$out" | tail -1)"
+else
+    echo "FAIL fleet_loadgen --selftest:"
+    echo "$out"
+    fail=1
+fi
+
+# trend_report: the longitudinal run ledger (synthetic render +
+# idempotent backfill from the committed BENCH/GATE/SLO artifacts, no
+# JAX) — the series bench_gate --trend gates against.
+if out=$(timeout 120 python scripts/trend_report.py --selftest 2>&1); then
+    echo "OK   trend_report --selftest: $(echo "$out" | tail -1)"
+else
+    echo "FAIL trend_report --selftest:"
+    echo "$out"
+    fail=1
+fi
+
 # roofline_report: the device-truth roofline pipeline (synthetic
 # CostRecord warehouse -> fusion-target verdict, JSONL/.gz round-trip,
 # no JAX backend) must keep ranking fusion candidates — the evidence
@@ -84,7 +108,8 @@ else
 fi
 
 # bench_gate: the BENCH-artifact regression differ (synthetic baseline
-# vs passing AND regressed payloads, plus the committed BENCH_r05
+# vs passing AND regressed payloads, trend pass/fail cells against a
+# synthetic ledger's rolling median, plus the committed BENCH_r05
 # self-gate) — every future PR's perf claim is checked by this tool,
 # so the tool itself is checked here (README "Telemetry warehouse &
 # bench gate").

@@ -161,6 +161,11 @@ def main() -> int:
                     help="fire one hedged duplicate for any request "
                          "still unresolved S seconds after submission "
                          "(implies --retry)")
+    ap.add_argument("--ledger", default=None, metavar="PATH",
+                    help="append one longitudinal run-ledger row "
+                         "(git rev + key metrics) to this JSONL — "
+                         "scripts/trend_report.py renders the series, "
+                         "bench_gate --trend gates against it")
     ap.add_argument("--seed", type=int, default=5)
     ap.add_argument("--factor", action="store_true",
                     help="carry the low-rank objective factor (Pf = X) "
@@ -203,6 +208,17 @@ def main() -> int:
         profile_window_s=args.profile_window,
         profile_dir=args.profile_dir)
     report["workload"] = args.workload
+    if args.ledger:
+        from porqua_tpu.obs import ledger as _ledger
+
+        row = _ledger.ledger_row(
+            "serve_loadgen", _ledger.metrics_from_loadgen(report),
+            rev=_ledger.git_rev(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))),
+            note=f"workload={args.workload} mode={args.mode}"
+                 + (f" chaos={args.chaos}" if args.chaos else ""))
+        _ledger.append_row(args.ledger, row)
+        report["ledger_row"] = row["run_id"]
     print(json.dumps(report))
     # Under --chaos, errors are the scenario doing its job (failed
     # requests are an allowed outcome; wrong answers are not, and the

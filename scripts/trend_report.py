@@ -1,0 +1,291 @@
+#!/usr/bin/env python
+"""Render the longitudinal run ledger: per-metric trajectories.
+
+The ledger (``LEDGER.jsonl``, :mod:`porqua_tpu.obs.ledger`) holds one
+schema-versioned row per measured run — git revision, run kind, flat
+key metrics, gate verdict, artifact path — appended by ``bench.py`` /
+``scripts/serve_loadgen.py`` / ``scripts/fleet_loadgen.py`` via their
+``--ledger`` flag. This script is the reader:
+
+* default: one trajectory block per metric — sparkline over the rows
+  that carry it, first/last/median values, and the last-vs-rolling-
+  median drift (the same rolling median ``bench_gate --trend`` gates
+  against, so the report previews the gate).
+* ``--backfill``: seed the ledger from the committed artifacts
+  (``BENCH_r01``-``BENCH_r05``, ``BENCH_GATE_r07.json``,
+  ``SLO_r09.json``) so the series starts with real history instead of
+  an empty file. Idempotent: rows are keyed by ``run_id`` and never
+  appended twice.
+* ``--selftest``: synthetic ledger render + a real backfill round
+  trip into a temp ledger (no JAX) — wired into
+  ``scripts/run_tests.sh``.
+
+Examples::
+
+    python scripts/trend_report.py --backfill          # seed LEDGER.jsonl
+    python scripts/trend_report.py                     # render it
+    python scripts/bench_gate.py --trend LEDGER.jsonl --payload fresh.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
+
+DEFAULT_LEDGER = os.path.join(_REPO_ROOT, "LEDGER.jsonl")
+
+
+# ---------------------------------------------------------------------------
+# backfill: committed artifacts -> ledger rows
+# ---------------------------------------------------------------------------
+
+def _bench_wrapper_row(path: str, run_id: str) -> Optional[Dict[str, Any]]:
+    """One row from a committed ``BENCH_rNN.json`` driver wrapper.
+    Rounds whose TPU window starved (r01 rc=1, r02 rc=124 — no
+    ``parsed`` payload) still get a row: a failed run is history too,
+    and the empty-metrics row never contributes to a rolling median."""
+    from porqua_tpu.obs import ledger
+
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except OSError:
+        return None
+    parsed = data.get("parsed")
+    t = os.path.getmtime(path)
+    if not isinstance(parsed, dict):
+        return ledger.ledger_row(
+            "bench", {}, run_id=run_id, artifact=os.path.basename(path),
+            note=f"no parsed payload (rc={data.get('rc')})", t=t)
+    return ledger.ledger_row(
+        "bench", ledger.metrics_from_bench(parsed), run_id=run_id,
+        artifact=os.path.basename(path), t=t)
+
+
+def _gate_artifact_row(path: str, run_id: str) -> Optional[Dict[str, Any]]:
+    """One row from the committed ``BENCH_GATE_r07.json`` (payload +
+    verdict in one artifact)."""
+    from porqua_tpu.obs import ledger
+
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except OSError:
+        return None
+    parsed = data.get("parsed")
+    verdict = data.get("verdict") or {}
+    if not isinstance(parsed, dict):
+        return None
+    return ledger.ledger_row(
+        "bench", ledger.metrics_from_bench(parsed), run_id=run_id,
+        gate=verdict if verdict else None,
+        artifact=os.path.basename(path),
+        t=float(verdict.get("t", os.path.getmtime(path))))
+
+
+def _slo_artifact_rows(path: str) -> List[Dict[str, Any]]:
+    """Two rows from the committed ``SLO_r09.json`` interleaved A/B:
+    the bare arm and the full-plane arm, each as a serve_loadgen run
+    (best-of figures, as the artifact's protocol states)."""
+    from porqua_tpu.obs import ledger
+
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except OSError:
+        return []
+    rows = []
+    t = os.path.getmtime(path)
+    for arm, run_id in (("baseline", "SLO_r09.bare"),
+                        ("full_plane", "SLO_r09.full_plane")):
+        payload = data.get(arm)
+        if not isinstance(payload, dict):
+            continue
+        rows.append(ledger.ledger_row(
+            "serve_loadgen", ledger.metrics_from_loadgen(payload),
+            run_id=run_id, artifact=os.path.basename(path),
+            note=f"arm={arm} ({data.get('workload', '?')})", t=t))
+    return rows
+
+
+#: The committed-history inventory the backfill walks, in round order.
+def _backfill_rows(root: str) -> List[Dict[str, Any]]:
+    rows: List[Dict[str, Any]] = []
+    for n in range(1, 6):
+        row = _bench_wrapper_row(
+            os.path.join(root, f"BENCH_r0{n}.json"), f"BENCH_r0{n}")
+        if row is not None:
+            rows.append(row)
+    row = _gate_artifact_row(
+        os.path.join(root, "BENCH_GATE_r07.json"), "BENCH_GATE_r07")
+    if row is not None:
+        rows.append(row)
+    rows.extend(_slo_artifact_rows(os.path.join(root, "SLO_r09.json")))
+    return rows
+
+
+def backfill(ledger_path: str, root: str = _REPO_ROOT) -> Dict[str, int]:
+    """Append every committed-artifact row whose ``run_id`` the ledger
+    does not already hold. Returns ``{appended, skipped}``."""
+    from porqua_tpu.obs import ledger
+
+    existing = {r.get("run_id") for r in ledger.load_ledger(ledger_path)}
+    appended = skipped = 0
+    for row in _backfill_rows(root):
+        if row["run_id"] in existing:
+            skipped += 1
+            continue
+        ledger.append_row(ledger_path, row)
+        existing.add(row["run_id"])
+        appended += 1
+    return {"appended": appended, "skipped": skipped}
+
+
+# ---------------------------------------------------------------------------
+# rendering
+# ---------------------------------------------------------------------------
+
+def render_trends(rows: List[Dict[str, Any]],
+                  window: int = 5,
+                  metrics: Optional[List[str]] = None) -> str:
+    """One block per metric: the run-over-run series as a sparkline,
+    first/last/median, and last-vs-rolling-median drift (the rolling
+    median over the PRIOR ``window`` rows — the exact bar
+    ``bench_gate --trend`` gates the next run against)."""
+    from porqua_tpu.obs import ledger
+    from porqua_tpu.obs.report import sparkline
+
+    if not rows:
+        return "run ledger: (empty — run trend_report.py --backfill)"
+    lines = [f"run ledger trajectory ({len(rows)} rows, "
+             f"rolling window {window})"]
+    by_kind: Dict[str, int] = {}
+    for r in rows:
+        by_kind[str(r.get("kind", "?"))] = by_kind.get(
+            str(r.get("kind", "?")), 0) + 1
+    lines.append("  rows: " + ", ".join(
+        f"{k} x{v}" for k, v in sorted(by_kind.items())))
+    gated = [r for r in rows if isinstance(r.get("gate"), dict)]
+    if gated:
+        bad = [r["run_id"] for r in gated if not r["gate"].get("ok")]
+        lines.append(f"  gate verdicts: {len(gated)} recorded, "
+                     f"{len(bad)} failed"
+                     + (f" ({', '.join(bad)})" if bad else ""))
+    if metrics is None:
+        seen: List[str] = []
+        for r in rows:
+            for k in (r.get("metrics") or {}):
+                if k not in seen:
+                    seen.append(k)
+        metrics = seen
+    for metric in metrics:
+        series = [(str(r.get("run_id", "?")), float(r["metrics"][metric]))
+                  for r in rows
+                  if isinstance(r.get("metrics"), dict)
+                  and isinstance(r["metrics"].get(metric), (int, float))]
+        if not series:
+            continue
+        values = [v for _, v in series]
+        med = ledger.rolling_median(
+            [{"metrics": {metric: v}} for v in values[:-1]] or
+            [{"metrics": {metric: values[-1]}}], metric, window=window)
+        last = values[-1]
+        drift = ((last - med) / abs(med)) if med else 0.0
+        lines.append(
+            f"  {metric:<44} {sparkline(values, width=24)} "
+            f"n={len(values)}")
+        lines.append(
+            f"    first {values[0]:.6g}  last {last:.6g}  "
+            f"median[{min(window, max(len(values) - 1, 1))}] "
+            f"{med:.6g}  last-vs-median {drift:+.1%} "
+            f"({series[0][0]} -> {series[-1][0]})")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# selftest
+# ---------------------------------------------------------------------------
+
+def _selftest() -> int:
+    import tempfile
+
+    from porqua_tpu.obs import ledger
+
+    with tempfile.TemporaryDirectory() as td:
+        # Synthetic ledger: a drifting metric across five runs.
+        path = os.path.join(td, "LEDGER.jsonl")
+        for i, v in enumerate((2.4, 2.5, 2.6, 2.5, 1.9)):
+            ledger.append_row(path, ledger.ledger_row(
+                "bench", {"vs_baseline": v, "value": 3.0 + 0.1 * i},
+                run_id=f"r{i}", t=float(i)))
+        rows = ledger.load_ledger(path)
+        assert len(rows) == 5
+        med = ledger.rolling_median(rows, "vs_baseline", window=4)
+        assert abs(med - 2.5) < 1e-12, med
+        text = render_trends(rows, window=4)
+        for needle in ("run ledger trajectory (5 rows",
+                       "vs_baseline", "value", "bench x5",
+                       "last 1.9"):
+            assert needle in text, f"selftest: {needle!r} missing"
+        # Backfill round trip against the real committed artifacts:
+        # appends real history, and a second pass appends nothing.
+        bpath = os.path.join(td, "BACKFILL.jsonl")
+        first = backfill(bpath)
+        assert first["appended"] >= 6, first
+        again = backfill(bpath)
+        assert again["appended"] == 0, again
+        assert again["skipped"] == first["appended"] + first["skipped"]
+        brows = ledger.load_ledger(bpath)
+        ids = [r["run_id"] for r in brows]
+        for rid in ("BENCH_r03", "BENCH_r05", "BENCH_GATE_r07",
+                    "SLO_r09.full_plane"):
+            assert rid in ids, ids
+        gate_rows = [r for r in brows if r.get("gate")]
+        assert gate_rows and gate_rows[0]["gate"]["ok"] is True
+        # The failed early rounds are history, not medians: their
+        # empty metrics never contribute to the rolling bar.
+        med = ledger.rolling_median(brows, "vs_baseline", window=3,
+                                    kind="bench")
+        assert med is not None and med > 0, med
+        print(render_trends(brows))
+    print("\ntrend_report selftest: ok")
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--ledger", default=DEFAULT_LEDGER,
+                    help=f"ledger path (default {DEFAULT_LEDGER})")
+    ap.add_argument("--backfill", action="store_true",
+                    help="seed the ledger from the committed "
+                         "BENCH/GATE/SLO artifacts (idempotent)")
+    ap.add_argument("--window", type=int, default=5,
+                    help="rolling-median window (matches bench_gate "
+                         "--trend-window)")
+    ap.add_argument("--metric", action="append", default=None,
+                    help="render only these metrics (repeatable)")
+    ap.add_argument("--selftest", action="store_true")
+    args = ap.parse_args()
+
+    if args.selftest:
+        return _selftest()
+
+    from porqua_tpu.obs import ledger
+
+    if args.backfill:
+        stats = backfill(args.ledger)
+        print(f"backfill: {stats['appended']} rows appended, "
+              f"{stats['skipped']} already present -> {args.ledger}")
+    rows = ledger.load_ledger(args.ledger)
+    print(render_trends(rows, window=args.window, metrics=args.metric))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

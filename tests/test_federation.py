@@ -1,0 +1,735 @@
+"""Fleet telemetry federation, process vitals, and the run ledger
+(ISSUE 13 acceptance): collector merge/reconciliation/liveness, the
+worker-crash incident cell, bounded rollups, vitals leak trending,
+ledger trend gating, the single-service vitals gauges, and GC108."""
+
+import json
+import os
+import sys
+
+import pytest
+
+from porqua_tpu.obs import (
+    FleetCollector,
+    FlightRecorder,
+    SLOEngine,
+    VitalsTrend,
+    WorkerStream,
+    default_slos,
+    process_vitals,
+)
+from porqua_tpu.obs import ledger
+from porqua_tpu.obs.report import fleet_section
+from porqua_tpu.resilience.faults import FaultClock, FaultSpec
+
+_SCRIPTS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts")
+
+
+def slo_sample(completed, failed=0, counts=(0, 0), le=(0.01, 0.1),
+               expired=0):
+    """A synthetic cumulative ServeMetrics.slo_sample() payload."""
+    counts = tuple(counts) + (0,) * (len(le) + 1 - len(counts))
+    return {"completed": completed, "failed": failed,
+            "expired": expired, "retry_giveups": 0,
+            "validation_failures": 0, "latency_le": tuple(le),
+            "latency_counts": counts, "latency_count": sum(counts)}
+
+
+def make_fleet(tmp_path, workers=("w0", "w1"), clock=None, **kwargs):
+    clock = FaultClock() if clock is None else clock
+    kwargs.setdefault("heartbeat_timeout_s", 5.0)
+    kwargs.setdefault("rollup_window_s", 2.0)
+    col = FleetCollector(clock=clock, **kwargs)
+    streams = {}
+    for wid in workers:
+        path = str(tmp_path / f"{wid}.jsonl")
+        col.add_worker(wid, path)
+        streams[wid] = WorkerStream(path, wid)
+        streams[wid].hello(latency_le=[0.01, 0.1])
+    return col, streams, clock
+
+
+# ---------------------------------------------------------------------------
+# collector: merge / namespacing / robustness
+# ---------------------------------------------------------------------------
+
+class TestCollectorMerge:
+    def test_counters_and_raw_histograms_sum(self, tmp_path):
+        col, streams, _ = make_fleet(tmp_path)
+        streams["w0"].sample(slo_sample(10, failed=1, counts=(6, 4, 1)),
+                             hist={"solve_latency_seconds": {
+                                 "le": (0.01, 0.1), "counts": [6, 4, 1],
+                                 "sum": 0.4, "count": 11}})
+        streams["w1"].sample(slo_sample(20, counts=(15, 5, 0)),
+                             hist={"solve_latency_seconds": {
+                                 "le": (0.01, 0.1), "counts": [15, 5, 0],
+                                 "sum": 0.2, "count": 20}})
+        col.drain()
+        merged = col.slo_sample()
+        assert merged["completed"] == 30
+        assert merged["failed"] == 1
+        # RAW bucket counts merge element-wise — never percentiles.
+        assert merged["latency_counts"] == (21, 9, 1)
+        assert merged["latency_count"] == 31
+        hist = col.histograms()["solve_latency_seconds"]
+        assert hist["counts"] == [21, 9, 1]
+        assert hist["count"] == 31
+        assert abs(hist["sum"] - 0.6) < 1e-12
+
+    def test_cumulative_samples_replace_not_accumulate(self, tmp_path):
+        col, streams, _ = make_fleet(tmp_path, workers=("w0",))
+        streams["w0"].sample(slo_sample(10))
+        col.drain()
+        streams["w0"].sample(slo_sample(25))
+        streams["w0"].sample(slo_sample(40))
+        col.drain()
+        # Latest cumulative wins; draining twice must not double-count.
+        assert col.slo_sample()["completed"] == 40
+        col.drain()
+        assert col.slo_sample()["completed"] == 40
+
+    def test_trace_ids_namespaced_by_worker(self, tmp_path):
+        col, streams, _ = make_fleet(tmp_path)
+        for wid in ("w0", "w1"):
+            streams[wid].event({"kind": "backpressure_reject",
+                                "severity": "warn", "trace_id": "t17"})
+        col.drain()
+        ids = sorted(e["trace_id"]
+                     for e in col.events.events("backpressure_reject"))
+        assert ids == ["w0/t17", "w1/t17"]
+        workers = {e["worker"]
+                   for e in col.events.events("backpressure_reject")}
+        assert workers == {"w0", "w1"}
+
+    def test_partial_trailing_line_not_consumed(self, tmp_path):
+        col, streams, _ = make_fleet(tmp_path, workers=("w0",))
+        streams["w0"].sample(slo_sample(5))
+        col.drain()
+        with open(streams["w0"].path, "a") as f:
+            f.write('{"t": 1, "w": "w0", "kind": "sample", "slo"')
+        col.drain()
+        assert col.counters()["fleet_parse_errors"] == 0
+        assert col.slo_sample()["completed"] == 5
+        with open(streams["w0"].path, "a") as f:
+            f.write(': %s}\n' % json.dumps(slo_sample(9)))
+        col.drain()
+        assert col.slo_sample()["completed"] == 9
+
+    def test_garbage_line_counted_not_fatal(self, tmp_path):
+        col, streams, _ = make_fleet(tmp_path, workers=("w0",))
+        with open(streams["w0"].path, "a") as f:
+            f.write("not json at all\n")
+        streams["w0"].sample(slo_sample(3))
+        col.drain()
+        assert col.counters()["fleet_parse_errors"] == 1
+        assert col.slo_sample()["completed"] == 3
+
+    def test_mismatched_histogram_ladder_refused(self, tmp_path):
+        col = FleetCollector(clock=FaultClock())
+        streams = {}
+        for wid, le in (("a", [0.01, 0.1]), ("b", [0.02, 0.2])):
+            path = str(tmp_path / f"{wid}.jsonl")
+            col.add_worker(wid, path)
+            streams[wid] = WorkerStream(path, wid)
+            streams[wid].hello(latency_le=le)
+        streams["a"].sample(slo_sample(5, counts=(5, 0)))
+        with pytest.raises(ValueError, match="ladder"):
+            col.drain()
+        # The refusal is STICKY: a supervisor that catches the error
+        # and keeps polling must never see the mismatched worker's
+        # buckets summed against the fleet ladder — its samples are
+        # excluded from every merge surface, the error fires once, and
+        # the same-round records of the well-behaved worker landed.
+        assert col.slo_sample()["completed"] == 5
+        streams["b"].sample(slo_sample(99, counts=(90, 9)))
+        col.drain()  # no re-raise
+        assert col.slo_sample()["completed"] == 5
+        assert col.slo_sample()["latency_counts"] == (5, 0, 0)
+        assert col.counters()["fleet_ladder_refusals"] == 1
+        report = col.report()
+        statuses = {r["worker"]: r["status"] for r in report["rows"]}
+        assert statuses["b"] == "refused"
+        assert report["fleet"]["completed"] == 5
+        assert report["reconciled"], report["reconciliation"]
+        for s in streams.values():
+            s.close()
+
+    def test_fleet_throughput_sums_worker_measured_rates(self, tmp_path):
+        # Each worker times exactly its own measured soak window; the
+        # fleet rate is their sum. Collector lifetime (which starts
+        # before spawn + prewarm + warmup) must NOT be the denominator
+        # — that number deflates with host compile speed and would
+        # poison the trend-gated ledger series.
+        col, streams, clock = make_fleet(tmp_path)
+        clock.advance(300.0)  # a long prewarm before any completion
+        for wid in ("w0", "w1"):
+            streams[wid].sample(slo_sample(1200, counts=(1200, 0)))
+            streams[wid].report({
+                "completed": 1200, "failed": 0, "harvest_records": 1200,
+                "throughput_solves_per_s": 120.0, "duration_s": 10.0})
+        col.drain()
+        report = col.report()
+        assert report["fleet"]["throughput_solves_per_s"] == 240.0
+        assert report["reconciled"]
+
+    def test_mean_shaped_snap_keys_average_not_sum(self, tmp_path):
+        col, streams, _ = make_fleet(tmp_path)
+        for wid in ("w0", "w1"):
+            streams[wid].sample(slo_sample(10),
+                                snap={"occupancy_mean": 0.8,
+                                      "submitted": 10})
+        col.drain()
+        snap = col.snapshot()
+        # 2 workers at 0.8 occupancy are a fleet at 0.8, not 1.6 —
+        # while count-shaped keys still sum.
+        assert abs(snap["occupancy_mean"] - 0.8) < 1e-12
+        assert snap["submitted"] == 20.0
+
+    def test_dead_worker_vitals_leave_rollups_and_gauges(self, tmp_path):
+        col, streams, clock = make_fleet(tmp_path, rollup_window_s=2.0)
+        for wid in ("w0", "w1"):
+            streams[wid].sample(slo_sample(10),
+                                vitals={"rss_bytes": 5e8, "open_fds": 9,
+                                        "threads": 3, "queue_depth": 0})
+        clock.advance(2.0)
+        col.drain()
+        assert col.rollups()[-1]["rss_sum_bytes"] == 1e9
+        # w1 dies; its pre-crash RSS must not inflate later windows,
+        # and its frozen vitals must leave the live gauges (worker_up
+        # already says why) — the row keeps them for forensics.
+        clock.advance(6.0)
+        streams["w0"].sample(slo_sample(20),
+                             vitals={"rss_bytes": 5e8, "open_fds": 9,
+                                     "threads": 3, "queue_depth": 0})
+        col.drain()
+        assert col.worker_rows()[1]["status"] == "lost"
+        clock.advance(2.0)
+        col.drain()
+        assert col.rollups()[-1]["rss_sum_bytes"] == 5e8
+        gauges = col.worker_gauges()
+        assert [lbl["worker"] for lbl, _ in gauges["worker_rss_bytes"]] \
+            == ["w0"]
+        ups = {lbl["worker"]: v for lbl, v in gauges["worker_up"]}
+        assert ups == {"w0": 1.0, "w1": 0.0}
+        assert "vitals" in col.worker_rows()[1]
+
+    def test_stalled_poll_rollup_row_carries_true_span(self, tmp_path):
+        col, streams, clock = make_fleet(tmp_path, workers=("w0",),
+                                         rollup_window_s=2.0)
+        streams["w0"].sample(slo_sample(10))
+        clock.advance(2.0)
+        col.drain()
+        # The driver stalls for 3 windows; the single catch-up row
+        # must say it spans them, or rates derived from rollups spike.
+        streams["w0"].sample(slo_sample(70))
+        clock.advance(6.0)
+        col.drain()
+        rolls = col.rollups()
+        assert rolls[-1]["span_s"] == 6.0
+        assert rolls[-1]["completed"] == 60.0
+        assert rolls[0]["span_s"] == 2.0
+
+    def test_duplicate_worker_refused(self, tmp_path):
+        col = FleetCollector(clock=FaultClock())
+        col.add_worker("w0", str(tmp_path / "w0.jsonl"))
+        with pytest.raises(ValueError, match="already registered"):
+            col.add_worker("w0", str(tmp_path / "other.jsonl"))
+
+
+# ---------------------------------------------------------------------------
+# liveness + the worker-crash incident cell
+# ---------------------------------------------------------------------------
+
+class TestLiveness:
+    def test_stale_worker_fires_exactly_one_worker_lost_bundle(
+            self, tmp_path):
+        clock = FaultClock()
+        flight = FlightRecorder(out_dir=None, debounce_s=0.0,
+                                clock=clock)
+        col, streams, _ = make_fleet(tmp_path, clock=clock,
+                                     flight=flight)
+        streams["w0"].sample(slo_sample(10))
+        streams["w1"].sample(slo_sample(10))
+        col.drain()
+        # w0 goes silent; w1 keeps heartbeating past the deadline.
+        for i in range(4):
+            clock.advance(2.0)
+            streams["w1"].sample(slo_sample(12 + i))
+            col.drain()
+        lost = col.events.events("worker_lost")
+        assert len(lost) == 1, lost
+        assert lost[0]["worker"] == "w0"
+        assert lost[0]["severity"] == "error"
+        kinds = [b["trigger"]["kind"] for b in flight.bundles()]
+        assert kinds.count("worker_lost") == 1, kinds
+        # Re-draining later never re-fires the same loss (w1 reports
+        # cleanly, so only w0's single loss ever exists).
+        streams["w1"].report({"completed": 15, "failed": 0})
+        col.drain()
+        clock.advance(10.0)
+        col.drain()
+        assert len(col.events.events("worker_lost")) == 1
+
+    def test_finished_worker_never_lost(self, tmp_path):
+        col, streams, clock = make_fleet(tmp_path, workers=("w0",))
+        streams["w0"].sample(slo_sample(8))
+        streams["w0"].report({"completed": 8, "failed": 0,
+                              "harvest_records": 8})
+        col.drain()
+        clock.advance(60.0)
+        assert col.check_liveness() == []
+        rows = col.worker_rows()
+        assert rows[0]["status"] == "ok"
+
+    def test_crash_cell_reconciles_over_survivors(self, tmp_path):
+        """The worker-failure satellite: a worker killed mid-soak must
+        yield exactly one worker_lost incident and a merged report
+        that still reconciles over the survivors — no hang, no
+        double-count."""
+        clock = FaultClock()
+        flight = FlightRecorder(out_dir=str(tmp_path / "incidents"),
+                                debounce_s=0.0, clock=clock)
+        col, streams, _ = make_fleet(
+            tmp_path, workers=("w0", "w1", "w2"), clock=clock,
+            flight=flight)
+        # All three run; w1 dies at completed=40 (mid-line write, the
+        # kill -9 signature), the others finish cleanly.
+        for wid, n in (("w0", 50), ("w1", 40), ("w2", 60)):
+            streams[wid].sample(slo_sample(n, counts=(n, 0)))
+        with open(streams["w1"].path, "a") as f:
+            f.write('{"t": 2, "w": "w1", "kind": "sam')  # torn write
+        col.drain()
+        for i in range(4):
+            clock.advance(2.0)
+            for wid, n in (("w0", 50 + i), ("w2", 60 + i)):
+                streams[wid].sample(slo_sample(n, counts=(n, 0)))
+            col.drain()
+        for wid, n in (("w0", 53), ("w2", 63)):
+            streams[wid].sample(slo_sample(n, counts=(n, 0)))
+            streams[wid].report({
+                "completed": n, "failed": 0, "harvest_records": n,
+                "recompiles_after_warmup": 0,
+                "throughput_solves_per_s": 10.0})
+        col.drain()
+        report = col.report()
+        assert report["workers_lost"] == ["w1"]
+        assert report["reconciled"], report["reconciliation"]
+        # Fleet completed counts the lost worker's LAST KNOWN total
+        # exactly once; survivor harvest == survivor completed.
+        assert report["fleet"]["completed"] == 53 + 40 + 63
+        assert report["fleet"]["harvest_records"] == 53 + 63
+        assert len(col.events.events("worker_lost")) == 1
+        paths = [p for p in flight.bundles() if isinstance(p, str)]
+        wl = [p for p in paths if "worker_lost" in os.path.basename(p)]
+        assert len(wl) == 1, paths
+        from porqua_tpu.obs import load_bundle
+
+        bundle = load_bundle(wl[0])
+        assert bundle["trigger"]["kind"] == "worker_lost"
+        assert bundle["trigger"]["worker"] == "w1"
+        assert bundle["counters"]["workers_lost"] == 1
+        # The fleet section renders the incident the way the satellite
+        # specifies: liveness verdict line + reconciliation verdict.
+        text = fleet_section(report)
+        assert "worker liveness: 2 ok, 1 lost" in text
+        assert "LOST: w1" in text
+        assert "reconciliation: OK" in text
+
+
+# ---------------------------------------------------------------------------
+# fleet SLO + rollups
+# ---------------------------------------------------------------------------
+
+class TestFleetSLOAndRollups:
+    def test_fleet_burn_rate_fires_over_merged_windows(self, tmp_path):
+        clock = FaultClock()
+        engine = SLOEngine(default_slos(), clock=clock,
+                           min_eval_interval_s=0.0)
+        flight = FlightRecorder(out_dir=None, debounce_s=0.0,
+                                clock=clock)
+        col, streams, _ = make_fleet(tmp_path, clock=clock, slo=engine,
+                                     flight=flight)
+        streams["w0"].sample(slo_sample(100))
+        streams["w1"].sample(slo_sample(100))
+        col.drain()
+        # Worker w1 starts failing hard; the availability burn crosses
+        # the fast rule over the MERGED window even though w0 is fine.
+        clock.advance(10.0)
+        streams["w0"].sample(slo_sample(110))
+        streams["w1"].sample(slo_sample(102, failed=90))
+        col.drain()
+        status = engine.status()
+        assert status["alerts_fired"] >= 1, status
+        alerts = col.events.events("slo_alert")
+        assert any(e["state"] == "firing" for e in alerts)
+        kinds = [b["trigger"]["kind"] for b in flight.bundles()]
+        assert "slo_alert" in kinds
+
+    def test_rollup_ring_is_bounded_with_exact_deltas(self, tmp_path):
+        col, streams, clock = make_fleet(
+            tmp_path, workers=("w0",), rollup_capacity=4,
+            rollup_window_s=2.0)
+        total = 0
+        for i in range(12):
+            total += 10
+            streams["w0"].sample(slo_sample(total))
+            clock.advance(2.0)
+            col.drain()
+        rolls = col.rollups()
+        assert len(rolls) <= 4  # the memory bound
+        # Every retained window carries exactly its own delta.
+        assert all(r["completed"] == 10.0 for r in rolls[1:]), rolls
+        assert col.snapshot()["rollup_windows"] <= 4
+
+    def test_worker_gauges_and_fleet_exposition(self, tmp_path):
+        import urllib.request
+
+        col, streams, _ = make_fleet(tmp_path)
+        streams["w0"].sample(slo_sample(7, counts=(5, 2, 0)),
+                             hist={"solve_latency_seconds": {
+                                 "le": (0.01, 0.1), "counts": [5, 2, 0],
+                                 "sum": 0.1, "count": 7}},
+                             vitals={"rss_bytes": 1.5e8, "open_fds": 33,
+                                     "threads": 9, "queue_depth": 2})
+        streams["w1"].sample(slo_sample(9))
+        col.drain()
+        gauges = col.worker_gauges()
+        assert ({"worker": "w0"}, 7.0) in gauges["worker_completed"]
+        assert ({"worker": "w0"}, 1.5e8) in gauges["worker_rss_bytes"]
+        port = col.start_http()
+        try:
+            text = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10
+            ).read().decode()
+            assert 'porqua_fleet_worker_completed{worker="w0"} 7' in text
+            assert 'porqua_fleet_worker_up{worker="w1"} 1' in text
+            assert "porqua_fleet_solve_latency_seconds_bucket" in text
+            health = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10).read())
+            assert health["ok"] and health["workers"] == 2
+        finally:
+            col.stop_http()
+
+
+# ---------------------------------------------------------------------------
+# vitals
+# ---------------------------------------------------------------------------
+
+class TestVitals:
+    def test_process_vitals_sane(self):
+        v = process_vitals(queue_depth=5)
+        assert v["queue_depth"] == 5
+        assert v["threads"] >= 1
+        assert v.get("rss_bytes", 1) > 0
+        assert v.get("open_fds", 1) > 0
+
+    def test_leak_fires_once_with_hysteresis(self):
+        trend = VitalsTrend(min_samples=4, alpha_fast=0.6,
+                            alpha_slow=0.05)
+        fired = []
+        for i in range(12):
+            fired += trend.observe("w0", {"rss_bytes": 1000 * 1.4 ** i})
+        firing = [e for e in fired if e["state"] == "firing"]
+        assert len(firing) == 1
+        assert firing[0]["kind"] == "vitals_anomaly"
+        assert firing[0]["metric"] == "rss_bytes"
+        # A flat tail clears it exactly once (hysteresis).
+        flat = trend.status()["groups"]["w0/rss_bytes"]["ewma_fast"]
+        resolved = []
+        for _ in range(40):
+            resolved += trend.observe("w0", {"rss_bytes": flat * 0.4})
+        assert sum(1 for e in resolved
+                   if e["state"] == "resolved") == 1
+        st = trend.status()
+        assert st["fired"] == 1 and st["resolved"] == 1
+
+    def test_bursty_queue_depth_not_trended_by_default(self):
+        # queue_depth oscillates by design (open-loop bursts between
+        # batch drains); a ratio trend on it fired 15 false anomalies
+        # in a clean 4-worker soak. Default judged set excludes it —
+        # the samples still flow (gauges + rollup high-water marks).
+        trend = VitalsTrend(min_samples=4, alpha_fast=0.6,
+                            alpha_slow=0.05)
+        events = []
+        for i in range(60):
+            events += trend.observe(
+                "w0", {"queue_depth": 0 if i % 3 else 400,
+                       "rss_bytes": 1e8})
+        assert events == []
+        assert "w0/queue_depth" not in trend.status()["groups"]
+
+    def test_steady_process_never_fires(self):
+        trend = VitalsTrend(min_samples=4)
+        events = []
+        for i in range(50):
+            events += trend.observe(
+                "w0", {"rss_bytes": 1e8 + (i % 3) * 1e5, "threads": 12})
+        assert events == []
+
+    def test_vitals_anomaly_is_flight_trigger_on_firing_edge_only(self):
+        from porqua_tpu.obs import EventBus
+
+        clock = FaultClock()
+        bus = EventBus()
+        flight = FlightRecorder(out_dir=None, debounce_s=0.0,
+                                clock=clock)
+        bus.add_listener(flight.on_event)
+        trend = VitalsTrend(min_samples=4, alpha_fast=0.6,
+                            alpha_slow=0.05, events=bus)
+        for i in range(12):
+            trend.observe("w0", {"rss_bytes": 1000 * 1.4 ** i})
+        kinds = [b["trigger"]["kind"] for b in flight.bundles()]
+        assert kinds == ["vitals_anomaly"]
+        peak = trend.status()["groups"]["w0/rss_bytes"]["ewma_fast"]
+        for _ in range(40):
+            trend.observe("w0", {"rss_bytes": peak * 0.4})
+        # The resolve transition is history, not an incident.
+        kinds = [b["trigger"]["kind"] for b in flight.bundles()]
+        assert kinds == ["vitals_anomaly"]
+
+    def test_service_exports_vitals_gauges_and_healthz(self):
+        import urllib.request
+
+        import numpy as np
+
+        from porqua_tpu.qp.canonical import CanonicalQP
+        from porqua_tpu.serve.service import SolveService
+
+        n = 4
+        qp = CanonicalQP(
+            P=np.eye(n, dtype=np.float32),
+            q=np.zeros(n, np.float32),
+            C=np.ones((1, n), np.float32),
+            l=np.ones(1, np.float32), u=np.ones(1, np.float32),
+            lb=np.zeros(n, np.float32), ub=np.ones(n, np.float32),
+            var_mask=np.ones(n, np.float32),
+            row_mask=np.ones(1, np.float32),
+            constant=np.float32(0))
+        service = SolveService(max_batch=4)
+        with service:
+            service.prewarm(qp)
+            port = service.start_http()
+            text = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=30
+            ).read().decode()
+            assert "porqua_serve_vitals_rss_bytes" in text
+            assert "porqua_serve_vitals_threads" in text
+            assert "porqua_serve_vitals_queue_depth 0" in text
+            health = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=30).read())
+            assert health["vitals"]["threads"] >= 1
+            assert health["vitals"]["queue_depth"] == 0
+            assert health["vitals"].get("rss_bytes", 1) > 0
+
+
+# ---------------------------------------------------------------------------
+# ledger + trend gate
+# ---------------------------------------------------------------------------
+
+class TestLedger:
+    def test_row_roundtrip_and_rolling_median(self, tmp_path):
+        path = str(tmp_path / "LEDGER.jsonl")
+        for i, v in enumerate((2.0, 2.2, 2.4, 2.6, 2.8, 9.9)):
+            ledger.append_row(path, ledger.ledger_row(
+                "bench", {"vs_baseline": v}, run_id=f"r{i}",
+                rev="abc1234", t=float(i)))
+        rows = ledger.load_ledger(path)
+        assert len(rows) == 6
+        assert rows[0]["v"] == ledger.LEDGER_SCHEMA_VERSION
+        assert rows[0]["rev"] == "abc1234"
+        # Median over the last 5 rows, robust to the 9.9 outlier.
+        assert ledger.rolling_median(rows, "vs_baseline",
+                                     window=5) == 2.6
+        assert ledger.rolling_median(rows, "missing") is None
+        assert ledger.rolling_median(rows, "vs_baseline",
+                                     kind="fleet_loadgen") is None
+        assert ledger.load_ledger(str(tmp_path / "nope.jsonl")) == []
+
+    def test_unknown_kind_refused(self):
+        with pytest.raises(ValueError, match="unknown ledger kind"):
+            ledger.ledger_row("mystery", {})
+
+    def test_metrics_from_fleet_counts_workers_lost(self):
+        report = {"workers": 4, "workers_lost": ["w1", "w3"],
+                  "fleet": {"completed": 100}, "reconciled": True}
+        flat = ledger.metrics_from_fleet(report)
+        # The report carries ids; the trend series needs the count —
+        # a crash cell must leave a workers_lost=1 point, not nothing.
+        assert flat["workers_lost"] == 2
+        assert flat["fleet.completed"] == 100
+        assert ledger.metrics_from_fleet(
+            {"workers_lost": []})["workers_lost"] == 0
+
+    def test_metrics_extractors_flatten_dotted_paths(self):
+        bench = {"value": 3.6, "vs_baseline": 2.5,
+                 "config_serving": {"throughput_solves_per_s": 3000.0},
+                 "xla_cost": {"flops": 1e12},
+                 "device": "tpu:0"}
+        flat = ledger.metrics_from_bench(bench)
+        assert flat["config_serving.throughput_solves_per_s"] == 3000.0
+        assert flat["xla_cost.flops"] == 1e12
+        assert "device" not in flat
+        assert ledger.nest_metrics(flat)["config_serving"][
+            "throughput_solves_per_s"] == 3000.0
+
+    @pytest.fixture()
+    def gate(self):
+        sys.path.insert(0, _SCRIPTS)
+        try:
+            import bench_gate
+        finally:
+            sys.path.remove(_SCRIPTS)
+        return bench_gate
+
+    def test_trend_gate_pass_and_fail(self, gate, tmp_path):
+        path = str(tmp_path / "LEDGER.jsonl")
+        base = gate._synthetic_baseline()
+        for i in range(5):
+            ledger.append_row(path, ledger.ledger_row(
+                "bench", ledger.metrics_from_bench(base),
+                run_id=f"r{i}", t=float(i)))
+        good = json.loads(json.dumps(base))
+        good["vs_baseline"] *= 0.95
+        v = gate.check_trend(path, good, window=5)
+        assert v["ok"], v["failed"]
+        assert v["trend"]["rows_of_kind"] == 5
+        bad = json.loads(json.dumps(base))
+        bad["vs_baseline"] *= 0.4
+        bad["config_compaction"]["te_drift"] = 1.0  # invariant break
+        v_bad = gate.check_trend(path, bad, window=5)
+        assert not v_bad["ok"]
+        assert "headline_speedup" in v_bad["failed"]
+        assert "compaction_te_parity" in v_bad["failed"]
+        # The drift that pairwise gates miss: five slowly-degrading
+        # rows, each within 0.7x of its predecessor, but the next step
+        # falls below 0.7x of the window's median.
+        drift_path = str(tmp_path / "DRIFT.jsonl")
+        v0 = base["vs_baseline"]
+        for i, scale in enumerate((1.0, 0.85, 0.72, 0.62, 0.53)):
+            row = json.loads(json.dumps(base))
+            row["vs_baseline"] = v0 * scale
+            ledger.append_row(drift_path, ledger.ledger_row(
+                "bench", ledger.metrics_from_bench(row),
+                run_id=f"d{i}", t=float(i)))
+        next_step = json.loads(json.dumps(base))
+        next_step["vs_baseline"] = v0 * 0.45  # 0.85x of its predecessor
+        v_drift = gate.check_trend(drift_path, next_step, window=5)
+        assert "headline_speedup" in v_drift["failed"], v_drift
+
+    def test_trend_retired_metric_ages_out_of_baseline(self, gate,
+                                                       tmp_path):
+        # A metric only rows OLDER than the window carry (renamed or
+        # intentionally retired) must age out of the trend baseline —
+        # not fail every future run as a coverage regression forever.
+        path = str(tmp_path / "RETIRED.jsonl")
+        base = gate._synthetic_baseline()
+        old = ledger.metrics_from_bench(base)
+        old["xla_cost.flops"] = 1e12  # carried only by the old rows
+        for i in range(2):
+            ledger.append_row(path, ledger.ledger_row(
+                "bench", old, run_id=f"old{i}", t=float(i)))
+        new = {k: v for k, v in ledger.metrics_from_bench(base).items()
+               if k != "xla_cost.flops"}
+        for i in range(5):
+            ledger.append_row(path, ledger.ledger_row(
+                "bench", new, run_id=f"new{i}", t=float(10 + i)))
+        candidate = json.loads(json.dumps(base))
+        candidate.get("xla_cost", {}).pop("flops", None)
+        v = gate.check_trend(path, candidate, window=5)
+        assert v["ok"], v["failed"]
+        assert all(c["baseline"] is None for c in v["checks"]
+                   if c["name"] == "xla_flops_drift"), v["checks"]
+
+    def test_append_ledger_dispatches_extractor_by_kind(self, gate,
+                                                        tmp_path):
+        import subprocess
+
+        path = str(tmp_path / "FLEET_LEDGER.jsonl")
+        fleet_report = {"workers": 2, "workers_lost": [],
+                        "duration_s": 10.0,
+                        "fleet": {"completed": 3000, "failed": 0,
+                                  "throughput_solves_per_s": 300.0},
+                        "incident_bundles": 0, "reconciled": True}
+        payload = str(tmp_path / "fleet_report.json")
+        with open(payload, "w") as f:
+            json.dump(fleet_report, f)
+        out = subprocess.run(
+            [sys.executable, os.path.join(_SCRIPTS, "bench_gate.py"),
+             "--trend", path, "--trend-kind", "fleet_loadgen",
+             "--payload", payload, "--append-ledger"],
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stdout + out.stderr
+        rows = ledger.load_ledger(path)
+        assert len(rows) == 1
+        # A fleet payload lands a fleet row: kind + fleet.* metrics,
+        # not an empty dict from the bench extractor missing its paths.
+        assert rows[0]["kind"] == "fleet_loadgen"
+        assert rows[0]["metrics"]["fleet.completed"] == 3000
+        assert rows[0]["metrics"]["workers_lost"] == 0
+
+    def test_trend_selftest_and_backfill(self, gate, tmp_path):
+        assert gate._selftest() == 0
+        sys.path.insert(0, _SCRIPTS)
+        try:
+            import trend_report
+        finally:
+            sys.path.remove(_SCRIPTS)
+        path = str(tmp_path / "BF.jsonl")
+        stats = trend_report.backfill(path)
+        assert stats["appended"] >= 6
+        assert trend_report.backfill(path)["appended"] == 0  # idempotent
+        rows = ledger.load_ledger(path)
+        ids = {r["run_id"] for r in rows}
+        assert {"BENCH_r03", "BENCH_r05", "BENCH_GATE_r07",
+                "SLO_r09.full_plane"} <= ids
+        text = trend_report.render_trends(rows)
+        assert "run ledger trajectory" in text
+        assert "vs_baseline" in text
+
+
+# ---------------------------------------------------------------------------
+# the crash fault kind at the loadgen.worker seam + GC108
+# ---------------------------------------------------------------------------
+
+class TestSeamAndContract:
+    def test_crash_kind_allowed_at_loadgen_worker_seam(self):
+        spec = FaultSpec.make("loadgen.worker", "crash", start=3)
+        assert spec.seam == "loadgen.worker"
+        with pytest.raises(ValueError, match="cannot target"):
+            FaultSpec.make("loadgen.worker", "device_lost")
+
+    def test_injected_crash_fires_at_seeded_arrival(self):
+        from porqua_tpu.resilience import faults as _faults
+
+        scenario = _faults.Scenario(
+            "crash-cell",
+            faults=(_faults.FaultSpec.make("loadgen.worker", "crash",
+                                           start=5),),
+            seed=7)
+        inj = _faults.install(_faults.FaultInjector(scenario))
+        try:
+            hits = 0
+            with pytest.raises(_faults.InjectedCrash):
+                while True:
+                    if _faults.enabled():
+                        _faults.fire("loadgen.worker", k=hits)
+                    hits += 1
+            assert hits == 5  # fired exactly at seeded hit index 5
+            assert inj.exhausted()
+        finally:
+            _faults.uninstall()
+
+    def test_gc108_clean(self):
+        from porqua_tpu.analysis import contracts
+
+        assert contracts.check_federation_identity() == []
+
+    def test_worker_stream_never_raises_on_dead_sink(self, tmp_path):
+        path = str(tmp_path / "s.jsonl")
+        stream = WorkerStream(path, "w0")
+        stream.hello(latency_le=[0.1])
+        stream.close()
+        stream.sample(slo_sample(1))  # post-close: counted, not raised
+        assert stream.write_failures >= 1
+        assert stream.records == 1

@@ -342,7 +342,10 @@ class TestFlightRecorder:
         assert set(DEFAULT_TRIGGERS) == {
             "breaker_open", "retry_giveup", "validation_failed",
             "sanitizer_violation", "harvest_sink_failed", "slo_alert",
-            "convergence_anomaly"}
+            "convergence_anomaly",
+            # The fleet plane (obs/federation.py, obs/vitals.py): a
+            # crashed loadgen shard or a leaking worker is an incident.
+            "worker_lost", "vitals_anomaly"}
 
     def test_failed_dump_does_not_consume_debounce(self, tmp_path):
         # Review fix: a dump that fails to write must not spend the
