@@ -1267,14 +1267,22 @@ def run_device_benchmark(state):
             "median_te": main_p["median_te"]}
         # Backfill any configN parts the TPU child died before emitting
         # with the fallback's measurements — losing the TPU secondary
-        # work must not also discard the fallback's config-4/5 numbers.
-        # Each part keeps its own n_dates/n_bench fields, and the
-        # device label makes the provenance explicit.
+        # work must not also discard the fallback's config-4/5 numbers
+        # (the standing VERDICT item at the bench orchestration layer:
+        # a partial artifact is strictly worse than a cross-labeled
+        # one). Each part keeps its own n_dates/n_bench fields, the
+        # device label makes the provenance explicit, and the payload
+        # carries an explicit backfill note so a cold reader (or the
+        # bench gate) never mistakes a fallback number for a TPU one.
         have = {p.get("part") for p in state["secondary"]}
+        backfilled = []
         for p in payloads:
             part = p.get("part", "")
             if part.startswith("config") and part not in have:
                 state["secondary"].append({**p, "device": "cpu-fallback"})
+                backfilled.append(part)
+        if backfilled:
+            state["backfilled_configs"] = sorted(backfilled)
 
 
 class DeadlineReached(Exception):
@@ -1400,6 +1408,17 @@ def _assemble(state) -> dict:
         # TPU headline landed AND the background CPU fallback finished:
         # keep both on the record (cross-platform cross-check).
         payload["cpu_fallback"] = state["fallback_extra"]
+    if state.get("backfilled_configs"):
+        # Secondary parts the TPU child died before emitting, carried
+        # from the CPU fallback run instead of shipping a partial
+        # artifact — each such part also carries device:
+        # "cpu-fallback" inline.
+        payload["backfilled_configs"] = state["backfilled_configs"]
+        payload["backfill_note"] = (
+            "TPU child ended before emitting "
+            + ", ".join(state["backfilled_configs"])
+            + "; values backfilled from the CPU fallback run "
+              "(device: cpu-fallback on each part)")
     if state.get("turnover_cpu_per_date") is not None:
         c4 = payload.get("config4_turnover")
         per = state["turnover_cpu_per_date"]

@@ -47,6 +47,7 @@ __all__ = [
     "check_resilience_identity",
     "check_run_batch",
     "check_telemetry_identity",
+    "check_tenancy_identity",
     "compaction_step_jaxpr",
     "continuous_jaxprs",
     "solve_batch_jaxpr",
@@ -650,6 +651,125 @@ def check_federation_identity(dtype=np.float32) -> List[Finding]:
     return findings
 
 
+def check_tenancy_identity(dtype=np.float32) -> List[Finding]:
+    """GC109: the tenant plane must be invisible to XLA.
+
+    Tenancy (:mod:`porqua_tpu.serve.tenancy`, the per-tenant metrics
+    axis, :class:`porqua_tpu.obs.slo.TenantSLOSet`, the workload
+    library :mod:`porqua_tpu.serve.workloads`) promises it is
+    host-side scheduling + attribution ONLY: quotas shed at submit,
+    DRR reorders host deques, per-tenant counters/histograms/engines
+    are dict arithmetic, and workload blends are numpy built before
+    the clock starts — requests from different tenants coalesce into
+    the same compiled batches, and no program carries a tenant. This
+    check machine-verifies the enabled half of "tenancy disabled ==
+    bit-identical" (the runtime half is pinned by
+    ``tests/test_tenancy.py``): the solve/serve entry points are
+    traced bare, then the tenant plane is exercised FOR REAL — a
+    quota shed, a DRR interleave across a 10:1 backlog imbalance, a
+    per-tenant burn-rate alert fired on a stepped clock (its event
+    carrying the tenant label), a tenant-tagged SolveRecord, and a
+    seeded three-tenant workload blend — and the entry points are
+    re-traced. The jaxprs must be string-identical, and every probe
+    self-verifies it actually exercised its path (a shed that never
+    shed proves nothing).
+    """
+    from porqua_tpu.obs.events import EventBus
+    from porqua_tpu.obs.harvest import solve_record
+    from porqua_tpu.obs.slo import TenantSLOSet
+    from porqua_tpu.resilience.faults import FaultClock
+    from porqua_tpu.serve.metrics import ServeMetrics
+    from porqua_tpu.serve.tenancy import FairPendingQueue, TenantAdmission
+    from porqua_tpu.serve.workloads import (
+        build_blend, parse_tenant_specs)
+
+    def trace_all():
+        return [("solve_batch", str(solve_batch_jaxpr(dtype=dtype))),
+                ("serve_entry", str(serve_entry_jaxpr(dtype=dtype)))]
+
+    findings: List[Finding] = []
+    baseline = trace_all()
+
+    def probe_fail(msg: str) -> None:
+        findings.append(Finding(
+            "GC109", "<jaxpr:tenancy_identity>", 0, 0, msg))
+
+    # Quota shed: the offender hits its bound, the victim never does.
+    admission = TenantAdmission(quota={"noisy": 2})
+    sheds = sum(not admission.try_admit("noisy") for _ in range(5))
+    victim_ok = all(admission.try_admit("quiet") for _ in range(5))
+    if sheds != 3 or not victim_ok:
+        probe_fail("the quota probe did not shed exactly the "
+                   "offender's overflow — the identity check "
+                   "exercised a broken admission plane")
+
+    # DRR interleave: a 10:1 backlog imbalance still alternates
+    # tenants 1:1 at equal weights.
+    class _Req:
+        def __init__(self, tenant, i):
+            self.tenant, self.submitted = tenant, float(i)
+
+    fq = FairPendingQueue()
+    for i in range(10):
+        fq.append(_Req("noisy", i))
+    fq.append(_Req("quiet", 100.0))
+    first_four = [fq.popleft().tenant for _ in range(4)]
+    if "quiet" not in first_four[:2]:
+        probe_fail(f"DRR probe served {first_four} — the quiet "
+                   "tenant waited behind the burst backlog")
+
+    # Per-tenant SLO engines on a stepped clock: the offender's
+    # availability alert fires WITH its tenant label; the victim's
+    # engine stays quiet.
+    clock = FaultClock()
+    metrics = ServeMetrics()
+    events = EventBus(capacity=256)
+    tset = TenantSLOSet(clock=clock, min_eval_interval_s=0.0)
+    tset.bind(metrics, events=events)
+    for t in ("noisy", "quiet"):
+        metrics.inc_tenant(t, "completed")
+    tset.evaluate()
+    metrics.inc_tenant("noisy", "completed", 2)
+    metrics.inc_tenant("noisy", "rejected", 98)
+    metrics.inc_tenant("quiet", "completed", 100)
+    metrics.observe_tenant_latency("quiet", 0.004)
+    clock.advance(10.0)
+    tset.evaluate()
+    fired = tset.alerts_fired()
+    alert_tenants = {e.get("tenant")
+                     for e in events.events("slo_alert")
+                     if e.get("state") == "firing"}
+    if fired.get("noisy", 0) < 1 or fired.get("quiet", 0) != 0 \
+            or alert_tenants != {"noisy"}:
+        probe_fail("the per-tenant SLO probe did not fire exactly "
+                   "the offender's tenant-labeled alert "
+                   f"(fired={fired}, labels={alert_tenants})")
+
+    # Tenant-tagged SolveRecord + a seeded workload blend (numpy).
+    rec = solve_record("serve", 4, 2, 1, 10, 0.0, 0.0, 0.0,
+                       tenant="noisy")
+    blend = build_blend(parse_tenant_specs(
+        "a:tracking:steady:rate=20,n_assets=4,window=8,pool=2;"
+        "b:lad:heavy_tailed:rate=10,n_assets=4,window=8,pool=2;"
+        "c:turnover:bursty:rate=5,n_assets=4,window=8,pool=2"),
+        duration_s=2.0, seed=1)
+    if rec.get("tenant") != "noisy" or len(blend) < 3 \
+            or len(blend.shares()) != 3:
+        probe_fail("the harvest/workload probe did not produce a "
+                   "tenant-tagged record and a three-tenant blend")
+
+    live = trace_all()
+    for (label, base), (_, lv) in zip(baseline, live):
+        if base != lv:
+            findings.append(Finding(
+                "GC109", f"<jaxpr:{label}>", 0, 0,
+                "traced program differs with the tenant plane "
+                "exercised: tenancy is no longer host-side "
+                "scheduling + attribution only (disabled-bit-identity "
+                "contract broken)"))
+    return findings
+
+
 def run_batch_jaxpr(bs, params=None, dtype=np.float32) -> ClosedJaxpr:
     """Trace ``run_batch``'s device core against a *real*
     ``BacktestService``: the host pass (``build_problems``) runs for
@@ -750,4 +870,10 @@ def check_entry_points(dtype=np.float32,
     # all of it must leave the traced solve/serve programs string-
     # identical (the plane is host file/dict code end to end).
     findings += check_federation_identity(dtype=dtype)
+    # GC109: and for the tenant plane — a quota shed, a DRR
+    # interleave, a tenant-labeled burn-rate alert, a tenant-tagged
+    # harvest record, and a seeded workload blend must all leave the
+    # traced solve/serve programs string-identical (tenancy is
+    # host-side scheduling + attribution only).
+    findings += check_tenancy_identity(dtype=dtype)
     return findings

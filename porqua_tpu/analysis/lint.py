@@ -99,6 +99,7 @@ RULE_DOCS = {
     "GC106": "live plane (SLO/flight/anomaly) perturbs a traced program",
     "GC107": "device-truth cost plane perturbs a traced program",
     "GC108": "fleet federation plane perturbs a traced program",
+    "GC109": "tenant plane perturbs a traced program",
 }
 
 _CONTRACTIONS = {"dot", "einsum", "matmul", "tensordot", "inner", "vdot"}

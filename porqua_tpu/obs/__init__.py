@@ -83,7 +83,13 @@ from porqua_tpu.obs.ledger import (
 from porqua_tpu.obs.profile import StageProfiler, qp_solve_profile
 from porqua_tpu.obs.report import render_report
 from porqua_tpu.obs.rings import ring_history, solution_ring_history
-from porqua_tpu.obs.slo import SLO, BurnRateRule, SLOEngine, default_slos
+from porqua_tpu.obs.slo import (
+    SLO,
+    BurnRateRule,
+    SLOEngine,
+    TenantSLOSet,
+    default_slos,
+)
 from porqua_tpu.obs.trace import Span, SpanRecorder
 from porqua_tpu.obs.vitals import VitalsTrend, process_vitals
 
@@ -121,6 +127,7 @@ __all__ = [
     "Span",
     "SpanRecorder",
     "StageProfiler",
+    "TenantSLOSet",
     "VitalsTrend",
     "WorkerStream",
     "append_row",

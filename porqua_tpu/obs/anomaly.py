@@ -92,8 +92,10 @@ class AnomalyDetector:
         self.min_samples = int(min_samples)
         self.events = events
         self._lock = tsan.lock("AnomalyDetector")
-        # guarded-by: self._lock
-        self._groups: Dict[Tuple[str, Optional[float]], _GroupState] = {}
+        # (tenant, bucket, eps) online groups against (bucket, eps)
+        # baselines.                            guarded-by: self._lock
+        self._groups: Dict[Tuple[Optional[str], str, Optional[float]],
+                           _GroupState] = {}
         self._fired = 0            # guarded-by: self._lock
         self._resolved = 0         # guarded-by: self._lock
         self._unknown = 0          # guarded-by: self._lock
@@ -105,17 +107,43 @@ class AnomalyDetector:
     def from_aggregate(cls, agg: Dict[str, Any],
                        **kwargs) -> "AnomalyDetector":
         """Baselines from one :func:`porqua_tpu.obs.harvest.aggregate`
-        payload (``scripts/harvest_report.py``'s table)."""
-        baseline = {}
+        payload (``scripts/harvest_report.py``'s table).
+
+        Aggregates are per ``(tenant, bucket, eps)`` since harvest
+        schema v2; the BASELINE stays per ``(bucket, eps)`` — solver
+        convergence is physics of the problem class, not of who
+        submitted it — so tenant rows of the same (bucket, eps) merge:
+        counts sum, the p95/max band takes the widest tenant's value
+        (conservative: the band only ever loosens), the waste
+        attribution count-weights. Online EWMAs are still tracked per
+        (tenant, bucket, eps), so a single tenant's drift fires an
+        event naming that tenant."""
+        merged: Dict[tuple, Dict[str, float]] = {}
         for g in agg.get("groups", ()):
-            baseline[(str(g["bucket"]), _eps_key(g.get("eps_abs")))] = {
+            key = (str(g["bucket"]), _eps_key(g.get("eps_abs")))
+            count = int(g.get("count", 0))
+            row = {
                 "iters_p50": float(g["iters"]["p50"]),
                 "iters_p95": float(g["iters"]["p95"]),
                 "iters_max": float(g["iters"]["max"]),
                 "wasted": float(g.get("wasted_iteration_fraction", 0.0)),
-                "count": int(g.get("count", 0)),
+                "count": count,
             }
-        return cls(baseline, **kwargs)
+            base = merged.get(key)
+            if base is None:
+                merged[key] = row
+                continue
+            total = base["count"] + count
+            if total > 0:
+                base["iters_p50"] = (
+                    base["iters_p50"] * base["count"]
+                    + row["iters_p50"] * count) / total
+                base["wasted"] = (base["wasted"] * base["count"]
+                                  + row["wasted"] * count) / total
+            base["iters_p95"] = max(base["iters_p95"], row["iters_p95"])
+            base["iters_max"] = max(base["iters_max"], row["iters_max"])
+            base["count"] = total
+        return cls(merged, **kwargs)
 
     @classmethod
     def from_harvest(cls, path: str, **kwargs) -> "AnomalyDetector":
@@ -134,16 +162,22 @@ class AnomalyDetector:
 
     def observe(self, bucket: str, eps, iters: int,
                 segments: Optional[int] = None,
-                check_interval: int = 1) -> Optional[Dict[str, Any]]:
+                check_interval: int = 1,
+                tenant: Optional[str] = None) -> Optional[Dict[str, Any]]:
         """Fold one retired lane into its group's EWMAs and step the
         anomaly state machine; returns the transition event emitted
         (``None`` almost always). ``segments`` is the executed segment
         count where the caller knows it (continuous/compacted modes);
         classic mode derives ``ceil(iters / check_interval)`` — the
         same convention :func:`porqua_tpu.obs.harvest.solve_record`
-        uses, so online waste matches the baseline's attribution."""
-        key = (str(bucket), _eps_key(eps))
-        base = self.baseline.get(key)
+        uses, so online waste matches the baseline's attribution.
+        ``tenant`` splits the online EWMA per tenant against the
+        shared (bucket, eps) baseline, so one tenant's corrupt feed or
+        pathological stream fires an event carrying that tenant while
+        the others' groups stay clean."""
+        base_key = (str(bucket), _eps_key(eps))
+        key = (tenant, str(bucket), _eps_key(eps))
+        base = self.baseline.get(base_key)
         iters = int(iters)
         ci = max(int(check_interval), 1)
         segs = int(segments) if segments else max(-(-iters // ci), 1)
@@ -185,9 +219,10 @@ class AnomalyDetector:
     def _event(self, state: str, severity: str, key, g: _GroupState,  # guarded-by: self._lock
                base: Dict[str, float]) -> Dict[str, Any]:
         iters_band, waste_band = self._bands(base)
+        extra = {} if key[0] is None else {"tenant": key[0]}
         return dict(
             kind="convergence_anomaly", severity=severity,
-            state=state, bucket=key[0], eps=key[1],
+            state=state, bucket=key[1], eps=key[2], **extra,
             ewma_iters=round(g.ewma_iters, 2),
             ewma_waste=round(g.ewma_waste, 4),
             iters_band=round(iters_band, 2),
@@ -204,11 +239,13 @@ class AnomalyDetector:
         with self._lock:
             groups = {}
             anomalous: List[str] = []
-            for (bucket, eps), g in self._groups.items():
+            for (tenant, bucket, eps), g in self._groups.items():
                 base = self.baseline[(bucket, eps)]
                 iters_band, waste_band = self._bands(base)
                 label = (f"{bucket}@{eps:.0e}" if eps is not None
                          and math.isfinite(eps) else f"{bucket}@-")
+                if tenant is not None:
+                    label = f"{tenant}/{label}"
                 groups[label] = {
                     "n": g.n,
                     "ewma_iters": round(g.ewma_iters, 2),

@@ -496,6 +496,32 @@ class FleetCollector:
             out["latency_count"] += float(st.slo.get("latency_count", 0))
         return out
 
+    def _totals_tenants(self) -> Dict[str, Dict[str, float]]:  # guarded-by: self._lock
+        """Fleet-wide per-tenant counter sums from the workers' latest
+        snapshot sections (counters only — per-tenant latency
+        percentiles do NOT compose across workers and are never
+        merged; the fleet-level latency story stays with the raw
+        merged histograms)."""
+        out: Dict[str, Dict[str, float]] = {}
+        for st in self._workers.values():
+            if st.refused:
+                continue
+            tenants = st.snap.get("tenants")
+            if not isinstance(tenants, dict):
+                continue
+            for tenant, row in tenants.items():
+                if not isinstance(row, dict):
+                    continue
+                tgt = out.setdefault(str(tenant), {})
+                for k, v in row.items():
+                    if k.startswith("latency_"):
+                        continue
+                    if isinstance(v, bool) or not isinstance(
+                            v, (int, float)):
+                        continue
+                    tgt[k] = tgt.get(k, 0.0) + float(v)
+        return out
+
     def _roll(self, now: float) -> None:  # guarded-by: self._lock
         """Close any elapsed rollup window: one bounded aggregate row
         of the fleet's *deltas* over the window plus the vitals
@@ -634,6 +660,10 @@ class FleetCollector:
                     totals["completed"] / elapsed if elapsed > 0 else 0.0),
                 "rollup_windows": len(self._rollups),
             }
+            tenants = self._totals_tenants()
+            if tenants:
+                out["tenants"] = {t: {k: int(v) for k, v in row.items()}
+                                  for t, row in sorted(tenants.items())}
             return out
 
     def worker_gauges(self) -> Dict[str, List[Tuple[Dict[str, str], float]]]:
@@ -669,6 +699,13 @@ class FleetCollector:
                     v = st.vitals.get(key)
                     if v is not None:
                         series[name].append((lbl, float(v)))
+            # Fleet-wide per-tenant labeled series (merged across
+            # workers): porqua_fleet_tenant_<counter>{tenant="..."}.
+            for tenant, row in sorted(self._totals_tenants().items()):
+                lbl = {"tenant": tenant}
+                for k, v in row.items():
+                    series.setdefault(f"tenant_{k}", []).append(
+                        (lbl, float(v)))
             return {k: v for k, v in series.items() if v}
 
     def counters(self) -> Dict[str, int]:
@@ -784,6 +821,11 @@ class FleetCollector:
             "rollups_tail": self.rollups(last=8),
             "rollup_windows": len(self.rollups()),
         }
+        snap_tenants = self.snapshot().get("tenants")
+        if snap_tenants:
+            # The fleet tenant axis: merged per-tenant counters (the
+            # per-worker split stays in the rows' own reports).
+            out["tenants"] = snap_tenants
         if self.slo is not None:
             out["slo"] = self.slo.status()
         if self.vitals_trend is not None:

@@ -78,7 +78,21 @@ def device_label_of(tree) -> Optional[str]:
         return None
 
 #: Bump when a field changes meaning; additive fields don't need it.
-SCHEMA_VERSION = 1
+#: v2: records carry a ``tenant`` id (always — untagged producers
+#: write :data:`DEFAULT_TENANT`) and :func:`aggregate` groups per
+#: ``(tenant, bucket, eps)``. v1 records (e.g. the committed
+#: ``HARVEST_r07.json``) load unchanged with ``tenant`` defaulting to
+#: :data:`LEGACY_TENANT` — the sentinel keeps pre-tenant history
+#: distinguishable from a real ``"default"``-lane record.
+SCHEMA_VERSION = 2
+
+#: The tenant id a tenancy-unaware producer writes (matches
+#: ``porqua_tpu.serve.tenancy.DEFAULT_TENANT`` — literal here so the
+#: warehouse stays import-light).
+DEFAULT_TENANT = "default"
+
+#: What a v1 record's missing ``tenant`` field reads as.
+LEGACY_TENANT = "(pre-tenant)"
 
 #: Known values of a record's ``source`` field (producer provenance).
 SOURCES = ("serve", "serve.continuous", "batch", "batch.compacted",
@@ -106,6 +120,7 @@ def solve_record(source: str,
                  batch: Optional[int] = None,
                  compaction: Optional[Dict[str, Any]] = None,
                  profile: Optional[Dict[str, Any]] = None,
+                 tenant: Optional[str] = None,
                  **extra) -> Dict[str, Any]:
     """Build one SolveRecord dict (the schema's single constructor —
     every producer goes through here so fields cannot drift apart).
@@ -123,6 +138,10 @@ def solve_record(source: str,
         "v": SCHEMA_VERSION,
         "t": time.time(),
         "source": source,
+        # Always present since v2 (DEFAULT_TENANT for tenancy-unaware
+        # producers) so per-tenant reconciliation — tenant completed
+        # == tenant records — holds by construction.
+        "tenant": str(tenant) if tenant is not None else DEFAULT_TENANT,
         "n": int(n),
         "m": int(m),
         "status": int(status),
@@ -333,7 +352,8 @@ def harvest_solution(sink: Optional[HarvestSink],
                      warm_mask=None,
                      compaction: Optional[Dict[str, Any]] = None,
                      profile: Optional[Dict[str, Any]] = None,
-                     date_offset: int = 0) -> int:
+                     date_offset: int = 0,
+                     tenant: Optional[str] = None) -> int:
     """Explode one (possibly batched) QPSolution into SolveRecords.
 
     The shared device->dataset bridge for every batched producer
@@ -378,7 +398,7 @@ def harvest_solution(sink: Optional[HarvestSink],
             warm_src=warm_src if lane_warm else None,
             wall_s=wall_s, solve_s=solve_s, device=device,
             ring=ring, batch=B, compaction=compaction, profile=profile,
-            lane=int(date_offset) + i))
+            tenant=tenant, lane=int(date_offset) + i))
     return B
 
 
@@ -399,28 +419,35 @@ def _quantiles(values: List[float]) -> Dict[str, float]:
 def aggregate(records: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
     """Roll a harvest dataset up into the policy-ready table.
 
-    Per ``(bucket, eps_abs)`` group: record count, iteration
-    quantiles, status counts, the group's wasted-iteration attribution
-    (``1 - sum(segments) / (count * max(segments))`` — the straggler
-    tax a fused batch of exactly this group would pay), and the
-    warm-vs-cold mean-iteration delta (negative = warm starts help,
-    the figure a warm-start-seed policy trains against). The overall
-    section carries totals and per-source counts."""
+    Per ``(tenant, bucket, eps_abs)`` group (since schema v2 —
+    tenancy is the workload-segmentation axis the learned-policy loop
+    needs; v1 records group under :data:`LEGACY_TENANT`): record
+    count, iteration quantiles, status counts, the group's
+    wasted-iteration attribution (``1 - sum(segments) /
+    (count * max(segments))`` — the straggler tax a fused batch of
+    exactly this group would pay), and the warm-vs-cold
+    mean-iteration delta (negative = warm starts help, the figure a
+    warm-start-seed policy trains against). The overall section
+    carries totals and per-source / per-tenant counts."""
     groups: Dict[tuple, List[Dict[str, Any]]] = {}
     sources: Dict[str, int] = {}
+    tenants: Dict[str, int] = {}
     ring_records = 0
     for rec in records:
-        key = (str(rec.get("bucket", "?")), rec.get("eps_abs"))
+        tenant = str(rec.get("tenant", LEGACY_TENANT))
+        key = (tenant, str(rec.get("bucket", "?")), rec.get("eps_abs"))
         groups.setdefault(key, []).append(rec)
         src = str(rec.get("source", "?"))
         sources[src] = sources.get(src, 0) + 1
+        tenants[tenant] = tenants.get(tenant, 0) + 1
         if rec.get("ring"):
             ring_records += 1
 
     table = []
     total = 0
-    for (bucket, eps), recs in sorted(
-            groups.items(), key=lambda kv: (kv[0][0], kv[0][1] or 0.0)):
+    for (tenant, bucket, eps), recs in sorted(
+            groups.items(),
+            key=lambda kv: (kv[0][0], kv[0][1], kv[0][2] or 0.0)):
         total += len(recs)
         iters = [int(r["iters"]) for r in recs]
         segs = [int(r.get("segments", 1)) for r in recs]
@@ -432,6 +459,7 @@ def aggregate(records: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
         warm_iters = [int(r["iters"]) for r in recs if r.get("warm")]
         cold_iters = [int(r["iters"]) for r in recs if not r.get("warm")]
         row: Dict[str, Any] = {
+            "tenant": tenant,
             "bucket": bucket,
             "eps_abs": eps,
             "count": len(recs),
@@ -452,5 +480,6 @@ def aggregate(records: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
         "records": total,
         "ring_records": ring_records,
         "sources": sources,
+        "tenants": tenants,
         "groups": table,
     }

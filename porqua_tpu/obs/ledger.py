@@ -78,7 +78,11 @@ BENCH_METRICS = (
     "config_compaction.lane_segments_reduction",
 )
 
-#: Loadgen-report metrics lifted into a ledger row.
+#: Loadgen-report metrics lifted into a ledger row. The
+#: ``tenant_fairness.*`` paths exist only on multi-tenant runs
+#: (``run_loadgen(tenants=...)`` — README "Multi-tenant serving &
+#: workload library"); absent metrics are simply not recorded, same
+#: as any older report shape.
 LOADGEN_METRICS = (
     "throughput_solves_per_s",
     "latency_p50_ms",
@@ -88,6 +92,12 @@ LOADGEN_METRICS = (
     "errors",
     "solved",
     "dropped_arrivals",
+    "tenant_fairness.tenants",
+    "tenant_fairness.quiet_p99_ratio",
+    "tenant_fairness.victim_shed_share",
+    "tenant_fairness.offender_alerts",
+    "tenant_fairness.nonoffender_alerts",
+    "tenant_fairness.harvest_reconciled",
 )
 
 #: Fleet-report metrics lifted into a ledger row.

@@ -493,6 +493,54 @@ def fleet_section(report: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+def tenant_section(report: Dict[str, Any]) -> str:
+    """The tenant view of a multi-tenant loadgen run (the
+    ``run_loadgen(tenants=...)`` report, or any dict carrying its
+    ``tenants`` / ``tenant_fairness`` / ``tenant_slo`` keys): the
+    per-tenant counter/latency table, each tenant's SLO alert totals,
+    and the fairness/isolation verdict line the bench gate's fairness
+    rules machine-check."""
+    tenants = report.get("tenants") or {}
+    if not tenants:
+        return "tenants: (no per-tenant data)"
+    slo = report.get("tenant_slo") or {}
+    lines = [f"tenants ({len(tenants)})"]
+    lines.append(f"  {'tenant':<12} {'submitted':>9} {'completed':>9} "
+                 f"{'rejected':>8} {'expired':>7} {'failed':>7} "
+                 f"{'p50 ms':>8} {'p99 ms':>8} {'alerts':>7}")
+    for t, row in sorted(tenants.items()):
+        fired = (slo.get(t, {}).get("alerts_fired", 0)
+                 if isinstance(slo.get(t), dict) else 0)
+        lines.append(
+            f"  {t:<12} {row.get('submitted', 0):>9} "
+            f"{row.get('completed', 0):>9} {row.get('rejected', 0):>8} "
+            f"{row.get('expired', 0):>7} {row.get('failed', 0):>7} "
+            f"{row.get('latency_p50_ms', 0.0):>8.2f} "
+            f"{row.get('latency_p99_ms', 0.0):>8.2f} {fired:>7}")
+    fair = report.get("tenant_fairness")
+    if fair:
+        offenders = fair.get("offenders") or []
+        verdict_ok = (fair.get("victim_shed_share", 0.0) == 0.0
+                      and fair.get("nonoffender_alerts", 0) == 0)
+        lines.append(
+            f"  fairness: quiet p99 ratio "
+            f"{fair.get('quiet_p99_ratio', 1.0):.2f}, victim shed "
+            f"share {fair.get('victim_shed_share', 0.0):.4f}, alerts "
+            f"offender={fair.get('offender_alerts', 0)} / others="
+            f"{fair.get('nonoffender_alerts', 0)}"
+            + (f" (offenders: {', '.join(offenders)})" if offenders
+               else ""))
+        lines.append("  isolation: "
+                     + ("OK — no victim sheds, no non-offender alerts"
+                        if verdict_ok else "!! VIOLATED"))
+        if fair.get("harvest_reconciled") is not None:
+            lines.append(
+                "  per-tenant reconciliation: "
+                + ("exact — tenant completed == tenant harvest records"
+                   if fair["harvest_reconciled"] else "!! MISMATCH"))
+    return "\n".join(lines)
+
+
 def events_section(events: Sequence[Dict[str, Any]],
                    max_shown: int = 12) -> str:
     """Severity rollup + the most recent warn/error lines."""
@@ -518,11 +566,14 @@ def render_report(trace: Any = None,
                   snapshot: Optional[Dict[str, Any]] = None,
                   harvest: Optional[Sequence[Dict[str, Any]]] = None,
                   costs: Optional[Sequence[Dict[str, Any]]] = None,
-                  fleet: Optional[Dict[str, Any]] = None) -> str:
+                  fleet: Optional[Dict[str, Any]] = None,
+                  tenants: Optional[Dict[str, Any]] = None) -> str:
     """The full text report from whichever artifacts exist."""
     sections = []
     if fleet is not None:
         sections.append(fleet_section(fleet))
+    if tenants is not None:
+        sections.append(tenant_section(tenants))
     if snapshot is not None:
         sections.append(latency_section(snapshot))
     if trace is not None:
@@ -538,6 +589,6 @@ def render_report(trace: Any = None,
         sections.append(costs_section(costs, harvest=harvest))
     if not sections:
         return ("obs_report: no artifacts given (need --trace/--events"
-                "/--metrics/--harvest/--costs/--fleet)")
+                "/--metrics/--harvest/--costs/--fleet/--tenants)")
     rule = "-" * 64
     return f"\n{rule}\n".join(sections)

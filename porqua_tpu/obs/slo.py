@@ -53,6 +53,7 @@ __all__ = [
     "BurnRateRule",
     "DEFAULT_RULES",
     "SLOEngine",
+    "TenantSLOSet",
     "default_slos",
 ]
 
@@ -191,7 +192,13 @@ class SLOEngine:
                  rules: Sequence[BurnRateRule] = DEFAULT_RULES,
                  clock: Optional[Callable[[], float]] = None,
                  min_eval_interval_s: float = 1.0,
-                 max_samples: int = 4096) -> None:
+                 max_samples: int = 4096,
+                 labels: Optional[Dict[str, str]] = None) -> None:
+        # Static labels merged into every emitted slo_alert event —
+        # the per-tenant engines (TenantSLOSet) ride this to stamp
+        # their alerts with {"tenant": ...} so a flight bundle's
+        # trigger names the tenant that burned its budget.
+        self.labels: Dict[str, str] = dict(labels or {})
         self.slos: Tuple[SLO, ...] = tuple(
             default_slos() if slos is None else slos)
         if not self.slos:
@@ -402,7 +409,7 @@ class SLOEngine:
                 burn_long=round(st.burn_long, 4),
                 threshold=rule.burn_rate,
                 short_s=rule.short_s, long_s=rule.long_s,
-                rule_severity=rule.severity)
+                rule_severity=rule.severity, **self.labels)
 
         if st.state == "inactive":
             if cond:
@@ -504,3 +511,144 @@ class SLOEngine:
         with self._lock:
             return {"slo_alerts_fired": self._alerts_fired,
                     "slo_evaluations": self._evaluations}
+
+
+class TenantSLOSet:
+    """Per-tenant SLO engines over one :class:`ServeMetrics`.
+
+    The tenant axis of the live SLO plane, built by *reusing* the
+    engine rather than duplicating it: one unmodified
+    :class:`SLOEngine` per observed tenant, each bound to that
+    tenant's :meth:`~porqua_tpu.serve.metrics.ServeMetrics.
+    tenant_view` (the same reader-surface adapter the fleet collector
+    uses) and stamped with ``labels={"tenant": <id>}`` so its
+    ``slo_alert`` events — and therefore any flight-recorder bundle
+    they trigger — carry the tenant id. Engines are created lazily as
+    tenants appear, bounded by ``max_tenants`` (beyond it new tenants
+    are counted, not judged — same posture as the anomaly detector's
+    unknown groups).
+
+    Semantics note: a tenant's availability counts its quota sheds as
+    bad events (:meth:`ServeMetrics.tenant_slo_sample`) — a
+    noisy-neighbor burst therefore burns ONLY the offender's budget;
+    the victims' engines see their own clean counters.
+
+    Thread-safety: ``maybe_evaluate`` runs on the dispatch thread via
+    ``MicroBatcher._plane_tick`` and on scrape threads; the set's own
+    lock guards only the engine registry — each engine keeps its own
+    lock and evaluates outside ours.
+    """
+
+    def __init__(self,
+                 slos: Optional[Sequence[SLO]] = None,
+                 rules: Sequence[BurnRateRule] = DEFAULT_RULES,
+                 clock: Optional[Callable[[], float]] = None,
+                 min_eval_interval_s: float = 1.0,
+                 max_tenants: int = 64) -> None:
+        self._slos = tuple(default_slos() if slos is None else slos)
+        self._rules = tuple(rules)
+        self._clock = clock
+        self._min_eval_interval_s = float(min_eval_interval_s)
+        self._max_tenants = int(max_tenants)
+        self.metrics = None
+        self.events = None
+        self._lock = tsan.lock("TenantSLOSet")
+        self._engines: Dict[str, SLOEngine] = {}  # guarded-by: self._lock
+        self._overflow = 0                        # guarded-by: self._lock
+
+    def bind(self, metrics, events=None) -> "TenantSLOSet":
+        """Point the set at the serve stack's :class:`ServeMetrics`
+        (``SolveService`` calls this)."""
+        self.metrics = metrics
+        if events is not None:
+            self.events = events
+        return self
+
+    def _engines_for(self, tenants) -> List[SLOEngine]:
+        # Missing engines are constructed AND bound before they are
+        # published into the registry: a concurrent evaluator (the
+        # dispatch thread's _plane_tick vs a /metrics scrape) that
+        # sees a registered engine must be able to evaluate it — an
+        # unbound one would raise "SLOEngine.bind(metrics) first" into
+        # whichever thread lost the race. The double-read is benign:
+        # two racers may both build an engine for a new tenant; the
+        # second insert defers to the first (setdefault), and the
+        # loser's unbound engine is simply dropped.
+        with self._lock:
+            missing = [t for t in tenants if t not in self._engines]
+        for t in missing:
+            engine = SLOEngine(
+                self._slos, rules=self._rules, clock=self._clock,
+                min_eval_interval_s=self._min_eval_interval_s,
+                labels={"tenant": t})
+            engine.bind(self.metrics.tenant_view(t), events=self.events)
+            with self._lock:
+                if t in self._engines:
+                    continue
+                if len(self._engines) >= self._max_tenants:
+                    self._overflow += 1
+                    continue
+                self._engines[t] = engine
+        with self._lock:
+            return list(self._engines.values())
+
+    def maybe_evaluate(self) -> None:
+        """Clock-gated evaluation of every tenant's engine (each
+        engine gates itself, so this is one lock hop + N cheap clock
+        reads per dispatch)."""
+        if self.metrics is None:
+            return
+        for engine in self._engines_for(self.metrics.tenant_ids()):
+            engine.maybe_evaluate()
+
+    def evaluate(self) -> None:
+        """Force one evaluation per tenant engine (run-end closing
+        evaluation, same role as ``SLOEngine.evaluate``)."""
+        if self.metrics is None:
+            return
+        for engine in self._engines_for(self.metrics.tenant_ids()):
+            engine.evaluate()
+
+    def engine(self, tenant: str) -> Optional[SLOEngine]:
+        with self._lock:
+            return self._engines.get(str(tenant))
+
+    def status(self) -> Dict[str, Any]:
+        """Per-tenant ``SLOEngine.status()`` payloads (the
+        ``/healthz`` tenancy section + the loadgen report)."""
+        with self._lock:
+            engines = dict(self._engines)
+        return {t: e.status() for t, e in sorted(engines.items())}
+
+    def alerts_fired(self) -> Dict[str, int]:
+        """Per-tenant fired-alert totals — the fairness/isolation
+        figure (offender fires, nobody else does)."""
+        with self._lock:
+            engines = dict(self._engines)
+        return {t: e.status()["alerts_fired"]
+                for t, e in sorted(engines.items())}
+
+    def labeled_gauges(self) -> Dict[str, list]:
+        """Per-tenant labeled series for
+        ``prometheus_text(labeled_gauges=)``: each engine's flat
+        gauges re-shaped as ``tenant_slo_*{tenant=...}``."""
+        with self._lock:
+            engines = dict(self._engines)
+        out: Dict[str, list] = {}
+        for t, engine in sorted(engines.items()):
+            lbl = {"tenant": t}
+            for key, value in engine.gauges().items():
+                out.setdefault(f"tenant_{key}", []).append((lbl, value))
+        return out
+
+    def counters(self) -> Dict[str, int]:
+        with self._lock:
+            engines = dict(self._engines)
+            overflow = self._overflow
+        return {
+            "tenant_slo_engines": len(engines),
+            "tenant_slo_overflow": overflow,
+            "tenant_slo_alerts_fired": sum(
+                e.counters()["slo_alerts_fired"]
+                for e in engines.values()),
+        }

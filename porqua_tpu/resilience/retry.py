@@ -108,13 +108,16 @@ class _Entry:
 
     __slots__ = ("request_id", "qp", "warm_key", "deadline", "future",
                  "submitted", "attempts", "hedges", "inflight",
-                 "resolved", "last_exc")
+                 "resolved", "last_exc", "tenant")
 
     def __init__(self, request_id: str, qp, warm_key, deadline,
-                 submitted: float) -> None:
+                 submitted: float, tenant=None) -> None:
         self.request_id = request_id
         self.qp = qp
         self.warm_key = warm_key
+        # Tenant id for per-tenant attribution of validation failures
+        # and give-ups (and quota enforcement on every inner attempt).
+        self.tenant = tenant
         self.deadline = deadline        # absolute, manager clock; None
         self.future: Future = Future()  # the caller's future
         self.submitted = submitted
@@ -186,12 +189,15 @@ class RetryManager:
                     abandoned.append(entry)
         for entry in abandoned:
             self.metrics.inc("retry_giveups")
+            self.metrics.inc_tenant(entry.tenant or "default",
+                                    "retry_giveups")
             if self.events is not None:
                 last = entry.last_exc
                 self.events.emit(
                     "retry_giveup", "error",
                     request_id=entry.request_id, reason="stopped",
                     attempts=entry.attempts, hedges=entry.hedges,
+                    tenant=entry.tenant or "default",
                     error=(None if last is None
                            else f"{type(last).__name__}: {last}"))
             entry.future.set_exception(SolveError(
@@ -203,7 +209,8 @@ class RetryManager:
     def submit(self, qp, deadline_s: Optional[float] = None,
                warm_key: Optional[str] = None,
                timeout: Optional[float] = None,
-               request_id: Optional[str] = None):
+               request_id: Optional[str] = None,
+               tenant: Optional[str] = None):
         """Register (or deduplicate) one request and issue its first
         attempt; returns the service's Ticket type over the caller's
         future. A ``request_id`` already registered — in flight OR
@@ -223,7 +230,7 @@ class RetryManager:
                 return Ticket(future=entry.future, submitted=entry.submitted)
             entry = _Entry(request_id, qp, warm_key,
                            None if deadline_s is None else now + deadline_s,
-                           submitted=time.monotonic())
+                           submitted=time.monotonic(), tenant=tenant)
             self._entries[request_id] = entry
             # LRU-evict RESOLVED entries only: evicting an in-flight
             # one would fork its id (a duplicate submit registers a
@@ -286,7 +293,7 @@ class RetryManager:
         try:
             ticket = self.service._submit_raw(
                 qp, deadline_s=remaining, warm_key=entry.warm_key,
-                timeout=submit_timeout)
+                timeout=submit_timeout, tenant=entry.tenant)
         except Exception as exc:  # noqa: BLE001 - policy boundary
             from porqua_tpu.serve.service import QueueFull
 
@@ -331,15 +338,18 @@ class RetryManager:
             reason = validate_result(res)
             if reason is not None:
                 self.metrics.inc("validation_failures")
+                self.metrics.inc_tenant(entry.tenant or "default",
+                                        "validation_failures")
                 if self.events is not None:
                     # `kind` (the event kind) is emit's first
                     # positional; the attempt kind rides under its own
-                    # name.
+                    # name. The tenant rides along so a corrupt-feed
+                    # incident bundle names the offending tenant.
                     self.events.emit(
                         "validation_failed", "error",
                         request_id=entry.request_id, attempt_kind=kind,
                         trace_id=getattr(res, "trace_id", None),
-                        reason=reason)
+                        reason=reason, tenant=entry.tenant or "default")
                 exc = SolveError(
                     f"result validation failed ({reason}); the answer "
                     f"was withheld and the attempt treated as a failure")
@@ -403,11 +413,14 @@ class RetryManager:
             return
         if resolve_exc is not None:
             self.metrics.inc("retry_giveups")
+            self.metrics.inc_tenant(entry.tenant or "default",
+                                    "retry_giveups")
             if self.events is not None:
                 self.events.emit(
                     "retry_giveup", "error",
                     request_id=entry.request_id, reason=giveup_reason,
                     attempts=entry.attempts, hedges=entry.hedges,
+                    tenant=entry.tenant or "default",
                     error=f"{type(resolve_exc).__name__}: {resolve_exc}")
             entry.future.set_exception(resolve_exc)
             return

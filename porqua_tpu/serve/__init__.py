@@ -15,6 +15,17 @@ executables compiled once via ``jit(...).lower(...).compile()``.
         t = svc.submit(qp, warm_key="fund-a")
         res = svc.result(t, timeout=10.0)    # res.x, res.found, ...
 
+Tenancy (README "Multi-tenant serving & workload library"):
+``svc.submit(qp, tenant="fund-a")`` tags requests for per-tenant
+admission quotas (``SolveService(tenant_quota=...)`` — a bursting
+tenant sheds at its own bounded sub-queue), deficit-round-robin
+fair-share dequeue (:mod:`porqua_tpu.serve.tenancy`), per-tenant
+counters/latency histograms in ``ServeMetrics`` (labeled ``/metrics``
+series + a ``/healthz`` tenancy section), and per-tenant SLO engines
+(``SolveService(tenant_slos=porqua_tpu.obs.TenantSLOSet(...))``).
+Production-shaped multi-tenant traffic: :mod:`porqua_tpu.serve.
+workloads`.
+
 Observability: ``svc.snapshot()`` / ``ServeMetrics.write_jsonl``
 (schema in the README's "Observability" section), request span tracing
 + structured events via ``SolveService(obs=porqua_tpu.obs.
@@ -49,9 +60,17 @@ from porqua_tpu.serve.service import (
     SolveService,
     Ticket,
 )
+from porqua_tpu.serve.tenancy import (
+    DEFAULT_TENANT,
+    FairPendingQueue,
+    TenantAdmission,
+)
 
 __all__ = [
     "Bucket",
+    "DEFAULT_TENANT",
+    "FairPendingQueue",
+    "TenantAdmission",
     "BucketLadder",
     "BucketOverflow",
     "ContinuousBatcher",

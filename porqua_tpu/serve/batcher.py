@@ -41,6 +41,7 @@ from porqua_tpu.qp.admm import Status
 from porqua_tpu.qp.canonical import CanonicalQP, stack_qps
 from porqua_tpu.resilience import faults as _faults
 from porqua_tpu.serve.bucketing import Bucket, ExecutableCache, slot_count
+from porqua_tpu.serve.tenancy import DEFAULT_TENANT, FairPendingQueue
 
 
 def problem_fingerprint(qp: CanonicalQP) -> str:
@@ -104,6 +105,10 @@ class SolveRequest:
     # warm-start provenance harvest records carry; None = no key.
     warm_src: Optional[str] = None
     trace_id: Optional[str] = None   # obs span correlation id
+    # Tenant id for quota/fair-share scheduling + attribution (None =
+    # untagged, accounted under tenancy.DEFAULT_TENANT). Host-side
+    # only: the compiled programs never see it (contract GC109).
+    tenant: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -186,10 +191,22 @@ class MicroBatcher:
                  profiler=None,
                  slo=None,
                  flight=None,
-                 anomaly=None) -> None:
+                 anomaly=None,
+                 admission=None,
+                 tenant_weights=None,
+                 tenant_slos=None) -> None:
         self.cache = cache
         self.health = health
         self.metrics = metrics
+        # Tenancy (porqua_tpu.serve.tenancy): the shared admission
+        # accountant (quota depths decrement when requests leave the
+        # pending window) and the per-tenant DRR weights the per-bucket
+        # FairPendingQueues dequeue under. tenant_slos is the
+        # per-tenant SLO engine set evaluated in _plane_tick next to
+        # the service-wide engine.
+        self.admission = admission
+        self.tenant_weights = dict(tenant_weights or {})
+        self.tenant_slos = tenant_slos
         self.obs = obs  # optional porqua_tpu.obs.Observability
         # Optional porqua_tpu.obs.HarvestSink: one SolveRecord per
         # resolved request (problem features + outcome + decoded ring
@@ -212,7 +229,10 @@ class MicroBatcher:
         self.queue: "queue.Queue[Optional[SolveRequest]]" = queue.Queue(
             maxsize=queue_capacity)
         self.warm_cache = warm_cache
-        self._pending: Dict[Bucket, collections.deque] = {}
+        # Per-bucket pending requests: per-tenant FIFOs dequeued by
+        # deficit round robin — one tenant's backlog cannot starve
+        # another's dispatch slots (README "Multi-tenant serving").
+        self._pending: Dict[Bucket, FairPendingQueue] = {}
         self._thread: Optional[threading.Thread] = None
         self._stopping = threading.Event()
 
@@ -243,7 +263,11 @@ class MicroBatcher:
     def _route(self, req: Optional[SolveRequest]) -> None:
         if req is None:
             return
-        self._pending.setdefault(req.bucket, collections.deque()).append(req)
+        dq = self._pending.get(req.bucket)
+        if dq is None:
+            dq = self._pending[req.bucket] = FairPendingQueue(
+                self.admission, weights=self.tenant_weights)
+        dq.append(req)
 
     def _next_wakeup(self, now: float) -> float:
         """Seconds until the oldest pending request hits the age
@@ -299,6 +323,8 @@ class MicroBatcher:
             for r in reqs:
                 if not r.future.done():
                     self.metrics.inc("failed")
+                    self.metrics.inc_tenant(r.tenant or DEFAULT_TENANT,
+                                            "failed")
                     r.future.set_exception(SolveError(
                         f"batcher internal error: {exc!r}"))
 
@@ -312,13 +338,15 @@ class MicroBatcher:
         for r in reqs:
             if r.deadline is not None and now > r.deadline:
                 m.inc("expired")
+                m.inc_tenant(r.tenant or DEFAULT_TENANT, "expired")
                 if obs is not None and r.trace_id is not None:
                     obs.spans.record("queue_wait", r.submitted, now,
                                      trace_id=r.trace_id, expired=True)
                     obs.events.emit(
                         "deadline_expired", "warn", trace_id=r.trace_id,
                         queued_s=round(now - r.submitted, 4),
-                        late_s=round(now - r.deadline, 4))
+                        late_s=round(now - r.deadline, 4),
+                        tenant=r.tenant or DEFAULT_TENANT)
                 r.future.set_exception(DeadlineExpired(
                     f"deadline passed {now - r.deadline:.3f}s before "
                     f"dispatch (queued {now - r.submitted:.3f}s)"))
@@ -358,6 +386,7 @@ class MicroBatcher:
                     x0[i], y0[i] = hit
                     warm[i] = True
                     m.inc("warm_hits")
+                    m.inc_tenant(r.tenant or DEFAULT_TENANT, "warm_hits")
 
         t_exec0 = time.monotonic()
         out = self._execute(bucket, slots, dtype, qp, x0, y0, live)
@@ -437,11 +466,16 @@ class MicroBatcher:
         a dispatch's retirements): one clock-gated SLO evaluation and
         one clock-gated flight metric snapshot. Batch-grain on purpose
         — running these per lane added measurable per-request work for
-        signals that only change per dispatch."""
+        signals that only change per dispatch. The per-tenant SLO set
+        evaluates on the same clock gate (one engine per observed
+        tenant, each reading its tenant's counters — the
+        noisy-neighbor alert path)."""
         if self.flight is not None:
             self.flight.maybe_snapshot()
         if self.slo is not None:
             self.slo.maybe_evaluate()
+        if self.tenant_slos is not None:
+            self.tenant_slos.maybe_evaluate()
 
     #: Harvest-record provenance tag (the continuous batcher overrides).
     harvest_source = "serve"
@@ -471,6 +505,7 @@ class MicroBatcher:
         lane's own needed-segment count, which is what the aggregate's
         straggler attribution is defined over)."""
         m = self.metrics
+        tenant = r.tenant or DEFAULT_TENANT
         ok = int(status[i]) == Status.SOLVED
         if (ok and r.warm_key is not None and self.warm_cache is not None
                 and np.all(np.isfinite(xs[i])) and np.all(np.isfinite(ys[i]))):
@@ -481,6 +516,8 @@ class MicroBatcher:
             self.warm_cache.put((r.warm_key, bucket), xs[i], ys[i])
         m.observe_latency(done - r.submitted)
         m.inc("completed")
+        m.inc_tenant(tenant, "completed")
+        m.observe_tenant_latency(tenant, done - r.submitted)
         # Per-lane terminal Status at the API boundary: aggregate
         # solved counts alone cannot distinguish a MAX_ITER lane from
         # a converged one.
@@ -505,7 +542,7 @@ class MicroBatcher:
                 wall_s=done - r.submitted,
                 solve_s=solve_s, device=device_label,
                 trace_id=r.trace_id, ring=ring, segments=segments,
-                profile=profile)
+                profile=profile, tenant=tenant)
             if self.harvest is not None:
                 self.harvest.emit(rec)
             if self.flight is not None:
@@ -544,7 +581,8 @@ class MicroBatcher:
                 int(iters[i]),
                 segments=(segments if executed_segments is None
                           else executed_segments),
-                check_interval=int(params.check_interval))
+                check_interval=int(params.check_interval),
+                tenant=tenant)
 
     def _execute(self, bucket: Bucket, slots: int, dtype, qp, x0, y0,
                  live: List[SolveRequest]):
@@ -592,6 +630,8 @@ class MicroBatcher:
                         detail=str(exc))
                 for r in live:
                     self.metrics.inc("failed")
+                    self.metrics.inc_tenant(r.tenant or DEFAULT_TENANT,
+                                            "failed")
                     r.future.set_exception(SolveError(f"sanitizer: {exc}"))
                 return None
             except Exception as exc:  # noqa: BLE001 - device faults vary
@@ -608,6 +648,7 @@ class MicroBatcher:
                     break  # already on the last-resort device
         for r in live:
             self.metrics.inc("failed")
+            self.metrics.inc_tenant(r.tenant or DEFAULT_TENANT, "failed")
             r.future.set_exception(SolveError(
                 f"dispatch failed on every device: {last_exc!r}"))
         return None

@@ -66,6 +66,7 @@ from porqua_tpu.serve.batcher import (
     SolveRequest,
     _corrupt_lanes,
 )
+from porqua_tpu.serve.tenancy import DEFAULT_TENANT
 from porqua_tpu.serve.bucketing import Bucket, slot_count
 
 __all__ = ["ContinuousBatcher"]
@@ -235,11 +236,13 @@ class ContinuousBatcher(MicroBatcher):
 
     # -- cohort lifecycle --------------------------------------------
 
-    def _fail_pending(self, dq: "collections.deque", exc) -> None:
+    def _fail_pending(self, dq, exc) -> None:
         while dq:
             r = dq.popleft()
             if not r.future.done():
                 self.metrics.inc("failed")
+                self.metrics.inc_tenant(r.tenant or DEFAULT_TENANT,
+                                        "failed")
                 r.future.set_exception(SolveError(
                     f"continuous cohort creation failed: {exc!r}"))
 
@@ -279,6 +282,7 @@ class ContinuousBatcher(MicroBatcher):
             r = dq.popleft()
             if r.deadline is not None and now > r.deadline:
                 m.inc("expired")
+                m.inc_tenant(r.tenant or DEFAULT_TENANT, "expired")
                 if self.obs is not None and r.trace_id is not None:
                     self.obs.spans.record("queue_wait", r.submitted, now,
                                           trace_id=r.trace_id,
@@ -289,7 +293,8 @@ class ContinuousBatcher(MicroBatcher):
                     self.obs.events.emit(
                         "deadline_expired", "warn", trace_id=r.trace_id,
                         queued_s=round(now - r.submitted, 4),
-                        late_s=round(now - r.deadline, 4))
+                        late_s=round(now - r.deadline, 4),
+                        tenant=r.tenant or DEFAULT_TENANT)
                 r.future.set_exception(DeadlineExpired(
                     f"deadline passed {now - r.deadline:.3f}s before "
                     f"admission (queued {now - r.submitted:.3f}s)"))
@@ -314,6 +319,7 @@ class ContinuousBatcher(MicroBatcher):
                     cohort.x0[slot], cohort.y0[slot] = hit
                     cohort.warm[slot] = True
                     m.inc("warm_hits")
+                    m.inc_tenant(r.tenant or DEFAULT_TENANT, "warm_hits")
             cohort.staged.append(slot)
 
     def _tick_safe(self, bucket: Bucket, cohort: _Cohort) -> None:
@@ -342,6 +348,8 @@ class ContinuousBatcher(MicroBatcher):
         for r in cohort.reqs:
             if r is not None and not r.future.done():
                 self.metrics.inc("failed")
+                self.metrics.inc_tenant(r.tenant or DEFAULT_TENANT,
+                                        "failed")
                 r.future.set_exception(SolveError(
                     f"continuous cohort failed: {exc!r}"))
         self._cohorts.pop(bucket, None)

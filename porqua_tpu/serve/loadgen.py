@@ -83,6 +83,90 @@ def build_tracking_requests(n_requests: int,
     return out
 
 
+def prewarm_buckets(service: SolveService, requests) -> tuple:
+    """Prewarm every DISTINCT bucket ``requests`` touches (a
+    mixed-tenant blend carries tracking + LAD + turnover shapes — a
+    one-bucket prewarm would pay the other buckets' compiles inside
+    the measured window). Returns ``(n_compiled, warm_examples)`` —
+    one example request per bucket, for the caller's warmup round.
+    Shared by this module's :func:`run_loadgen` and the fleet worker
+    (``scripts/fleet_loadgen.py``), so the warmup contract (untagged,
+    one full round per bucket) cannot drift between drivers."""
+    n_compiled = 0
+    seen = set()
+    warm_examples = []
+    for q in requests:
+        bucket = service.ladder.select(q)
+        if bucket in seen:
+            continue
+        seen.add(bucket)
+        warm_examples.append(q)
+        n_compiled += service.prewarm(q)
+    return n_compiled, warm_examples
+
+
+def _tenant_fields(snap: Dict, tenant_set, tenants: List[str],
+                   offenders, sink) -> Dict:
+    """The report's tenant axis: per-tenant counter/latency rows, the
+    per-tenant SLO status, and the ``tenant_fairness`` block the
+    bench gate's fairness rules machine-check (quiet-tenant p99
+    ratio, victim shed share, alert isolation, exact per-tenant
+    harvest reconciliation). Warmup traffic runs untagged, so every
+    figure here covers exactly the measured window."""
+    measured = sorted(set(tenants))
+    snap_tenants = snap.get("tenants") or {}
+    rows = {t: snap_tenants.get(t, {}) for t in measured}
+    out: Dict = {"tenants": rows}
+    fired: Dict[str, int] = {}
+    if tenant_set is not None:
+        out["tenant_slo"] = tenant_set.status()
+        fired = tenant_set.alerts_fired()
+    off = set(offenders or ())
+    quiet = {t: r for t, r in rows.items() if t not in off}
+    p99s = [float(r.get("latency_p99_ms", 0.0)) for r in quiet.values()
+            if r.get("completed")]
+    fairness: Dict = {
+        "tenants": len(measured),
+        "offenders": sorted(off & set(measured)),
+        # Fair share among the NON-offending tenants: their p99s
+        # should agree however hard the offender bursts (DRR bounds a
+        # quiet tenant's queue wait by tenant count, not burst depth).
+        "quiet_p99_ratio": (max(p99s) / max(min(p99s), 1e-9)
+                            if len(p99s) >= 2 else 1.0),
+        # Quota isolation: quiet tenants shed NOTHING — only the
+        # offender's sub-queue overflows.
+        "victim_shed_share": (
+            sum(int(r.get("rejected", 0)) for r in quiet.values())
+            / max(sum(int(r.get("submitted", 0))
+                      for r in quiet.values()), 1)),
+        # Alert isolation: the offender's burn fires its own engines;
+        # nobody else's budget moves.
+        "offender_alerts": sum(v for t, v in fired.items() if t in off),
+        "nonoffender_alerts": sum(v for t, v in fired.items()
+                                  if t not in off and t in measured),
+    }
+    if sink is not None:
+        # Exact per-tenant reconciliation: one SolveRecord per
+        # completed request, per tenant (warmup records carry the
+        # untagged "default" lane and never count here).
+        from porqua_tpu.obs.harvest import load_harvest
+
+        sink.flush()
+        records = (load_harvest(sink.path) if sink.path is not None
+                   else sink.buffered())
+        counts: Dict[str, int] = {}
+        for rec in records:
+            t = str(rec.get("tenant", ""))
+            if t in rows:
+                counts[t] = counts.get(t, 0) + 1
+        out["tenant_harvest_records"] = counts
+        fairness["harvest_reconciled"] = int(all(
+            counts.get(t, 0) == int(rows[t].get("completed", 0))
+            for t in measured))
+    out["tenant_fairness"] = fairness
+    return out
+
+
 def run_loadgen(requests: List[CanonicalQP],
                 params: SolverParams = SERVE_PARAMS,
                 mode: str = "closed",
@@ -111,7 +195,13 @@ def run_loadgen(requests: List[CanonicalQP],
                 anomaly_baseline=None,
                 cost_out: Optional[str] = None,
                 profile_window_s: Optional[float] = None,
-                profile_dir: Optional[str] = None) -> Dict:
+                profile_dir: Optional[str] = None,
+                arrivals=None,
+                tenants: Optional[List[str]] = None,
+                tenant_quota=None,
+                tenant_weights=None,
+                tenant_slos=None,
+                offenders=None) -> Dict:
     """Drive ``requests`` through a :class:`SolveService`; return the
     report dict (throughput, percentiles, occupancy, recompiles).
 
@@ -197,11 +287,33 @@ def run_loadgen(requests: List[CanonicalQP],
     (stopped by a timer after that many seconds, or at run end if
     sooner) written under ``profile_dir`` — the report links it as
     ``profile_trace_dir``.
+
+    Tenancy (README "Multi-tenant serving & workload library"):
+    ``tenants`` tags each request with a tenant id (aligned with
+    ``requests``); ``arrivals`` replaces open-loop fixed-rate pacing
+    with per-request arrival offsets (seconds from the window start —
+    the :mod:`porqua_tpu.serve.workloads` blend shape);
+    ``tenant_quota`` / ``tenant_weights`` configure per-tenant
+    admission quotas and DRR dequeue weights; ``tenant_slos``
+    (``True`` for the default per-tenant SLO set, or a pre-built
+    :class:`porqua_tpu.obs.TenantSLOSet`) runs one burn-rate engine
+    per tenant; ``offenders`` names the tenants the report's
+    ``tenant_fairness`` section treats as noisy neighbors. Warmup
+    requests stay untagged (the shared "default" lane), so per-tenant
+    counters AND per-tenant harvest records cover exactly the
+    measured window — the report reconciles them tenant by tenant.
+    Like the live plane, the tenancy knobs wire at service
+    construction and raise against an external service.
     """
     if mode not in ("closed", "open"):
         raise ValueError(f"unknown mode {mode!r}; expected closed|open")
-    if mode == "open" and not rate:
-        raise ValueError("open-loop mode requires a rate (solves/s)")
+    if mode == "open" and not rate and arrivals is None:
+        raise ValueError("open-loop mode requires a rate (solves/s) "
+                         "or per-request arrival offsets (arrivals=)")
+    if arrivals is not None and len(arrivals) != len(requests):
+        raise ValueError("arrivals must align 1:1 with requests")
+    if tenants is not None and len(tenants) != len(requests):
+        raise ValueError("tenants must align 1:1 with requests")
     if no_retry and retry is not None:
         raise ValueError("no_retry=True contradicts an explicit retry "
                          "policy; pass one or the other")
@@ -230,8 +342,15 @@ def run_loadgen(requests: List[CanonicalQP],
     slo_engine = None
     flight = None
     anomaly = None
+    tenant_set = None
     own_service = service is None
     if own_service:
+        if tenant_slos:
+            from porqua_tpu.obs import TenantSLOSet
+
+            tenant_set = (tenant_slos
+                          if isinstance(tenant_slos, TenantSLOSet)
+                          else TenantSLOSet())
         if ring_size:
             params = dataclasses.replace(params, ring_size=int(ring_size))
         if trace_out or events_out or ring_size or slo or flight_out \
@@ -280,7 +399,10 @@ def run_loadgen(requests: List[CanonicalQP],
                                segment_budget=segment_budget,
                                retry=retry, harvest=sink,
                                profiler=profiler, slo=slo_engine,
-                               flight=flight, anomaly=anomaly)
+                               flight=flight, anomaly=anomaly,
+                               tenant_quota=tenant_quota,
+                               tenant_weights=tenant_weights,
+                               tenant_slos=tenant_set)
         service.start()
     else:
         obs = service.obs
@@ -289,6 +411,17 @@ def run_loadgen(requests: List[CanonicalQP],
         slo_engine = service.slo
         flight = service.flight
         anomaly = service.anomaly
+        tenant_set = service.tenant_slos
+        if tenant_quota is not None or tenant_weights or tenant_slos:
+            # Same posture as the live plane below: quotas, DRR
+            # weights, and the per-tenant engines wire at service
+            # construction — silently ignoring them would report a
+            # run the caller believes was quota-enforced.
+            raise ValueError(
+                "tenant_quota/tenant_weights/tenant_slos require the "
+                "service to be constructed here; build it with "
+                "SolveService(tenant_quota=..., tenant_weights=..., "
+                "tenant_slos=TenantSLOSet(...))")
         if slo or flight_out or anomaly_baseline:
             # Same posture as harvest_out below: the live plane wires
             # at service construction (the batchers hold the hooks) —
@@ -347,12 +480,16 @@ def run_loadgen(requests: List[CanonicalQP],
             profile_dir or "porqua_profile_trace",
             window_s=profile_window_s)
     try:
-        # Prewarm every slot-ladder executable for the stream's bucket,
-        # then reset the window: measured `compiles` == recompiles.
-        n_compiled = service.prewarm(requests[0])
-        # One full-batch round trip warms the dispatch path end to end.
+        # Prewarm every distinct bucket, then reset the window:
+        # measured `compiles` == recompiles.
+        n_compiled, warm_examples = prewarm_buckets(service, requests)
+        # One full-batch round trip warms the dispatch path end to end
+        # (plus one request per remaining bucket so every compiled
+        # ladder sees traffic). Untagged — the warmup stays off every
+        # tenant's measured ledger.
         warm_tickets = [service.submit(q) for q in
                         requests[:min(len(requests), max_batch)]]
+        warm_tickets += [service.submit(q) for q in warm_examples]
         for t in warm_tickets:
             service.result(t, timeout=120)
         service.metrics.reset_window()
@@ -392,7 +529,12 @@ def run_loadgen(requests: List[CanonicalQP],
             if mode == "closed":
                 sem.acquire()
             else:
-                next_due += 1.0 / rate
+                # Workload-shaped open loop: per-request arrival
+                # offsets (diurnal/bursty/heavy-tailed blends) when
+                # given, the classic fixed-rate grid otherwise.
+                next_due = (t0 + float(arrivals[i])
+                            if arrivals is not None
+                            else next_due + 1.0 / rate)
                 delay = next_due - time.perf_counter()
                 if delay > 0:
                     time.sleep(delay)
@@ -415,9 +557,16 @@ def run_loadgen(requests: List[CanonicalQP],
                 ticket = service.submit(
                     qp, deadline_s=deadline_s,
                     warm_key=str(i) if warm_keys else None,
-                    timeout=None if mode == "closed" else 0.0)
+                    timeout=None if mode == "closed" else 0.0,
+                    tenant=None if tenants is None else tenants[i])
             except QueueFull:
+                # Closed mode can still shed: a tenant at its quota
+                # rejects immediately (the blocking timeout only
+                # covers the shared queue). Hand the window slot back
+                # or the loop wedges after `inflight` sheds.
                 dropped += 1
+                if mode == "closed":
+                    sem.release()
                 continue
             if mode == "closed":
                 ticket.future.add_done_callback(lambda _f: sem.release())
@@ -467,6 +616,11 @@ def run_loadgen(requests: List[CanonicalQP],
             # evaluations still lands its slo_alert transitions in the
             # events_out JSONL (and can still trigger a flight dump).
             slo_engine.evaluate()
+        if tenant_set is not None:
+            # Same closing evaluation per tenant engine: a tenant's
+            # burn cresting at the end of the window must still land
+            # its tenant-labeled slo_alert (and flight bundle).
+            tenant_set.evaluate()
 
         obs_fields: Dict = {}
         if obs is not None:
@@ -557,6 +711,9 @@ def run_loadgen(requests: List[CanonicalQP],
             obs_fields["profile_window_s"] = profile_window_s
             if window_trace.error:
                 obs_fields["profile_trace_error"] = window_trace.error
+        if tenants is not None:
+            obs_fields.update(_tenant_fields(
+                snap, tenant_set, tenants, offenders, sink))
         if sink is not None:
             sink.flush()
             obs_fields.update({

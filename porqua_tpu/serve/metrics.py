@@ -67,6 +67,23 @@ COUNTERS = (
     "faults_injected",      # chaos: faults the injector fired
 )
 
+#: Per-tenant counter names (the tenant axis of the snapshot /
+#: exposition — README "Multi-tenant serving & workload library").
+#: Deliberately a small subset of COUNTERS: the figures that attribute
+#: load, outcome, and shed behavior to a tenant. Everything else
+#: (batches, compiles, breaker state) is service-wide by construction
+#: — tenants share executables and devices.
+TENANT_COUNTERS = (
+    "submitted",          # requests this tenant put into the queue
+    "completed",          # resolved with a solution
+    "failed",             # resolved with an error
+    "expired",            # deadline passed before dispatch/admission
+    "rejected",           # shed at the tenant's own quota OR the queue
+    "retry_giveups",      # recovery layer abandoned the request
+    "validation_failures",  # withheld non-finite answers
+    "warm_hits",          # warm-start cache hits
+)
+
 #: Status code -> counter suffix (mirrors porqua_tpu.qp.admm.Status —
 #: kept literal here so the metrics layer stays import-light).
 _STATUS_COUNTER = {
@@ -98,7 +115,9 @@ class ServeMetrics:
     """
 
     def __init__(self, latency_reservoir: int = 65536,
-                 latency_buckets=LATENCY_BUCKETS_S) -> None:
+                 latency_buckets=LATENCY_BUCKETS_S,
+                 max_tenants: int = 256,
+                 tenant_reservoir: int = 8192) -> None:
         self._lock = tsan.lock("ServeMetrics")
         self._reservoir_cap = int(latency_reservoir)
         buckets = tuple(float(b) for b in latency_buckets)
@@ -107,6 +126,11 @@ class ServeMetrics:
             raise ValueError("latency_buckets must be a non-empty, "
                              "strictly increasing sequence of seconds")
         self._latency_buckets = buckets
+        # Tenant cardinality is caller-controlled input: bound it.
+        # Tenant max_tenants+1 onward folds into one overflow bucket so
+        # an id-spraying client cannot grow the metrics without limit.
+        self._max_tenants = int(max_tenants)
+        self._tenant_reservoir_cap = int(tenant_reservoir)
         self.reset_window()
 
     def reset_window(self) -> None:
@@ -141,16 +165,81 @@ class ServeMetrics:
                     "counts": [0] * (len(ITERS_BUCKETS) + 1),
                     "sum": 0.0, "count": 0},
             }
+            # Per-tenant attribution (bounded — see __init__): each
+            # tenant carries its TENANT_COUNTERS, a latency reservoir,
+            # and a latency histogram on the same bucket ladder (the
+            # per-tenant SLO engines read good/bad counts off its
+            # edges exactly like the global engine does).
+            self._tenants: Dict[str, Dict[str, Any]] = {}
             self._degraded = getattr(self, "_degraded", False)
             self._device_label: Optional[str] = getattr(
                 self, "_device_label", None)
             self._window_start = time.monotonic()
+
+    _TENANT_OVERFLOW = "(overflow)"
+
+    def _tenant_state(self, tenant: str) -> Dict[str, Any]:  # guarded-by: self._lock
+        st = self._tenants.get(tenant)
+        if st is None:
+            if len(self._tenants) >= self._max_tenants:
+                tenant = self._TENANT_OVERFLOW
+                st = self._tenants.get(tenant)
+            if st is None:
+                st = self._tenants[tenant] = {
+                    "counters": {k: 0 for k in TENANT_COUNTERS},
+                    "lat": [],
+                    "lat_obs": 0,
+                    "hist": {"le": self._latency_buckets,
+                             "counts": [0] * (len(self._latency_buckets)
+                                              + 1),
+                             "sum": 0.0, "count": 0},
+                }
+        return st
 
     # -- mutators ----------------------------------------------------
 
     def inc(self, name: str, k: int = 1) -> None:
         with self._lock:
             self.counters[name] = self.counters.get(name, 0) + k
+
+    def inc_tenant(self, tenant: Optional[str], name: str,
+                   k: int = 1) -> None:
+        """Bump one per-tenant counter (``tenant=None`` is a no-op so
+        call sites need no branching; untagged requests are accounted
+        under :data:`porqua_tpu.serve.tenancy.DEFAULT_TENANT` by their
+        callers)."""
+        if tenant is None:
+            return
+        with self._lock:
+            st = self._tenant_state(str(tenant))
+            st["counters"][name] = st["counters"].get(name, 0) + k
+
+    def observe_tenant_latency(self, tenant: Optional[str],
+                               seconds: float) -> None:
+        """One request's end-to-end latency into its tenant's
+        reservoir + histogram (the global ``observe_latency`` is
+        called separately — tenant attribution never replaces the
+        service-wide series)."""
+        if tenant is None:
+            return
+        with self._lock:
+            st = self._tenant_state(str(tenant))
+            h = st["hist"]
+            i = 0
+            for i, le in enumerate(h["le"]):
+                if seconds <= le:
+                    break
+            else:
+                i = len(h["le"])
+            h["counts"][i] += 1
+            h["sum"] += float(seconds)
+            h["count"] += 1
+            if len(st["lat"]) < self._tenant_reservoir_cap:
+                st["lat"].append(seconds)
+            else:
+                st["lat"][st["lat_obs"]
+                          % self._tenant_reservoir_cap] = seconds
+            st["lat_obs"] += 1
 
     def set_device(self, label: str, degraded: bool = False) -> None:
         with self._lock:
@@ -300,6 +389,25 @@ class ServeMetrics:
                 out[f"latency_{name}_ms"] = (
                     float(np.percentile(lat, pct)) * 1e3 if lat.size else 0.0)
             out["latency_mean_ms"] = float(lat.mean()) * 1e3 if lat.size else 0.0
+            if self._tenants:
+                # The tenant axis: per-tenant counters + latency
+                # percentiles (schema: README "Multi-tenant serving &
+                # workload library"). Untagged requests are accounted
+                # under the shared "default" lane, so the section
+                # reconciles against `completed` even for callers that
+                # never pass a tenant.
+                tenants: Dict[str, Any] = {}
+                for t, st in sorted(self._tenants.items()):
+                    tl = np.asarray(st["lat"], dtype=np.float64)
+                    row: Dict[str, Any] = dict(st["counters"])
+                    for nm, pct in (("p50", 50), ("p99", 99)):
+                        row[f"latency_{nm}_ms"] = (
+                            float(np.percentile(tl, pct)) * 1e3
+                            if tl.size else 0.0)
+                    row["latency_mean_ms"] = (float(tl.mean()) * 1e3
+                                              if tl.size else 0.0)
+                    tenants[t] = row
+                out["tenants"] = tenants
             return out
 
     def histograms(self) -> Dict[str, Dict[str, Any]]:
@@ -334,6 +442,73 @@ class ServeMetrics:
                 "latency_count": int(h["count"]),
             }
 
+    def tenant_ids(self) -> List[str]:
+        """Tenants with any attributed observation this window."""
+        with self._lock:
+            return sorted(self._tenants)
+
+    def tenant_slo_sample(self, tenant: str) -> Dict[str, Any]:
+        """One tenant's cumulative SLO sample, in the exact
+        ``slo_sample`` shape so a per-tenant
+        :class:`~porqua_tpu.obs.slo.SLOEngine` consumes it unchanged.
+
+        One deliberate semantic difference from the service-wide
+        sample: quota sheds (``rejected``) count toward the tenant's
+        availability bad events — from the tenant's point of view a
+        request shed at its own sub-queue IS unavailability (that is
+        exactly the signal the noisy-neighbor alert must fire on),
+        whereas service-wide backpressure is the caller's flow-control
+        signal, not an outage."""
+        with self._lock:
+            st = self._tenants.get(str(tenant))
+            if st is None:
+                return {"completed": 0, "failed": 0, "expired": 0,
+                        "retry_giveups": 0, "validation_failures": 0,
+                        "latency_le": self._latency_buckets,
+                        "latency_counts": tuple(
+                            [0] * (len(self._latency_buckets) + 1)),
+                        "latency_count": 0}
+            c = st["counters"]
+            h = st["hist"]
+            return {
+                "completed": c["completed"],
+                "failed": c["failed"] + c["rejected"],
+                "expired": c["expired"],
+                "retry_giveups": c["retry_giveups"],
+                "validation_failures": c["validation_failures"],
+                "latency_le": tuple(h["le"]),
+                "latency_counts": tuple(h["counts"]),
+                "latency_count": int(h["count"]),
+            }
+
+    def tenant_view(self, tenant: str) -> "TenantMetricsView":
+        """A per-tenant object implementing the ``slo_sample()``
+        reader surface (the same adapter move the fleet collector
+        makes) — ``SLOEngine.bind`` accepts it unchanged."""
+        return TenantMetricsView(self, str(tenant))
+
+    def tenant_labeled_gauges(self) -> Dict[str, list]:
+        """Per-tenant labeled series for
+        ``prometheus_text(labeled_gauges=)``:
+        ``porqua_serve_tenant_<counter>{tenant="..."}`` plus the
+        latency percentiles. Tenant ids are caller-supplied strings —
+        the exposition layer escapes label values per the text-format
+        spec (pinned by test with a hostile id)."""
+        with self._lock:
+            series: Dict[str, list] = {}
+            for t, st in sorted(self._tenants.items()):
+                lbl = {"tenant": t}
+                for name, v in st["counters"].items():
+                    series.setdefault(f"tenant_{name}", []).append(
+                        (lbl, v))
+                tl = np.asarray(st["lat"], dtype=np.float64)
+                for nm, pct in (("p50", 50), ("p99", 99)):
+                    series.setdefault(f"tenant_latency_{nm}_ms",
+                                      []).append(
+                        (lbl, float(np.percentile(tl, pct)) * 1e3
+                         if tl.size else 0.0))
+            return series
+
     def write_jsonl(self, path: str) -> Dict[str, Any]:
         """Append one snapshot line to ``path``; returns the snapshot."""
         snap = self.snapshot()
@@ -361,3 +536,22 @@ class ServeMetrics:
                 "occupancy_mean": round(snap["occupancy_mean"], 4),
                 "compiles": snap["compiles"],
             }))
+
+
+class TenantMetricsView:
+    """One tenant's read-only projection of a :class:`ServeMetrics`.
+
+    Implements exactly the reader surface the per-tenant
+    :class:`~porqua_tpu.obs.slo.SLOEngine` needs (``slo_sample()``),
+    the same adapter pattern :class:`porqua_tpu.obs.federation.
+    FleetCollector` uses to run fleet SLOs through the unmodified
+    engine. Sheds (``rejected``) count as availability bad events —
+    see :meth:`ServeMetrics.tenant_slo_sample`.
+    """
+
+    def __init__(self, metrics: ServeMetrics, tenant: str) -> None:
+        self.metrics = metrics
+        self.tenant = tenant
+
+    def slo_sample(self) -> Dict[str, Any]:
+        return self.metrics.tenant_slo_sample(self.tenant)

@@ -44,6 +44,7 @@ from porqua_tpu.serve.batcher import (
 )
 from porqua_tpu.serve.bucketing import BucketLadder, ExecutableCache
 from porqua_tpu.serve.metrics import ServeMetrics
+from porqua_tpu.serve.tenancy import DEFAULT_TENANT, TenantAdmission
 
 import queue as _queue
 
@@ -286,6 +287,9 @@ class SolveService:
                  slo=None,
                  flight=None,
                  anomaly=None,
+                 tenant_quota=None,
+                 tenant_weights=None,
+                 tenant_slos=None,
                  **health_kwargs) -> None:
         self.params = params
         self.continuous = bool(continuous)
@@ -321,6 +325,17 @@ class SolveService:
         self.slo = slo
         self.flight = flight
         self.anomaly = anomaly
+        # Tenancy (README "Multi-tenant serving & workload library"):
+        # per-tenant admission quotas (a tenant over quota sheds at
+        # its OWN bounded sub-queue — QueueFull, counted per tenant),
+        # deficit-round-robin dequeue weights, and the per-tenant SLO
+        # engine set (porqua_tpu.obs.slo.TenantSLOSet). Host-side
+        # scheduling + attribution only: contract GC109 pins the
+        # compiled programs identical with the plane on or off.
+        self.admission = TenantAdmission(quota=tenant_quota)
+        self.tenant_slos = tenant_slos
+        if tenant_slos is not None:
+            tenant_slos.bind(self.metrics, events=events)
         if flight is not None:
             # The flight recorder observes everything this service
             # already produces: the metrics snapshot trajectory, the
@@ -383,7 +398,9 @@ class SolveService:
             queue_capacity=queue_capacity,
             warm_cache=WarmStartCache(warm_capacity) if warm_start else None,
             obs=obs, harvest=harvest, profiler=profiler,
-            slo=slo, flight=flight, anomaly=anomaly)
+            slo=slo, flight=flight, anomaly=anomaly,
+            admission=self.admission, tenant_weights=tenant_weights,
+            tenant_slos=tenant_slos)
         if self.continuous:
             # Continuous batching: cohorts step one segment at a time,
             # retire lanes the boundary they converge (or hit the
@@ -446,9 +463,22 @@ class SolveService:
                     histograms=self.metrics.histograms(),
                     extra_counters=self._obs_counters(),
                     extra_gauges=self._extra_gauges(),
-                    labeled_gauges=self.cache.prometheus_gauges()),
+                    labeled_gauges=self._labeled_gauges()),
                 health_fn=self._health_payload, host=host, port=port)
         return self._http.start()
+
+    def _labeled_gauges(self) -> dict:
+        """Label-carrying gauge series for the exposition: the
+        executable cache's per-bucket series plus the per-tenant
+        counter/latency series (``porqua_serve_tenant_*{tenant=...}``)
+        and, when a :class:`~porqua_tpu.obs.slo.TenantSLOSet` runs,
+        the per-tenant SLO compliance/burn/alert-state series."""
+        out = dict(self.cache.prometheus_gauges())
+        out.update(self.metrics.tenant_labeled_gauges())
+        if self.tenant_slos is not None:
+            self.tenant_slos.maybe_evaluate()
+            out.update(self.tenant_slos.labeled_gauges())
+        return out
 
     def _extra_gauges(self) -> dict:
         """Scrape-time gauge set: SLO burn rates/alert states (an
@@ -494,6 +524,8 @@ class SolveService:
             out.update(self.cache.cost_log.counters())
         if self.slo is not None:
             out.update(self.slo.counters())
+        if self.tenant_slos is not None:
+            out.update(self.tenant_slos.counters())
         if self.flight is not None:
             out.update(self.flight.counters())
         if self.anomaly is not None:
@@ -505,11 +537,16 @@ class SolveService:
         # requests keep completing on the fallback; ejecting the
         # instance for being degraded would turn a slowdown into an
         # outage. ok flips only when the service is not running.
+        # One snapshot serves the whole payload: each snapshot() call
+        # holds the metrics lock through the percentile math, so a
+        # second one per scrape doubles both the scrape cost and the
+        # window submit/dispatch threads block on that lock.
+        snap = self.metrics.snapshot()
         payload = {
             "ok": self._started,
             "started": self._started,
             "degraded": self.health.degraded,
-            "device": self.metrics.snapshot().get("device"),
+            "device": snap.get("device"),
             # Telemetry-plane loss counters: a liveness prober (or a
             # human) sees event/harvest loss without scraping the full
             # exposition.
@@ -533,6 +570,20 @@ class SolveService:
             # without scraping and parsing the full exposition.
             self.slo.maybe_evaluate()
             payload["slo"] = self.slo.status()
+        tenants = snap.get("tenants")
+        if tenants:
+            # The tenant axis in one endpoint: per-tenant counters +
+            # latency percentiles, live sub-queue depths against the
+            # quota, and (when a TenantSLOSet runs) each tenant's
+            # compliance/alert state — the noisy-neighbor smoke and
+            # external probes assert isolation here.
+            section: dict = {"tenants": tenants,
+                             "queue_depths": self.admission.depths(),
+                             "quota_sheds": self.admission.sheds()}
+            if self.tenant_slos is not None:
+                self.tenant_slos.maybe_evaluate()
+                section["slo"] = self.tenant_slos.status()
+            payload["tenancy"] = section
         return payload
 
     def __enter__(self) -> "SolveService":
@@ -585,7 +636,8 @@ class SolveService:
                deadline_s: Optional[float] = None,
                warm_key: Optional[str] = None,
                timeout: Optional[float] = None,
-               request_id: Optional[str] = None) -> Ticket:
+               request_id: Optional[str] = None,
+               tenant: Optional[str] = None) -> Ticket:
         """Queue one problem. ``deadline_s`` is a relative deadline: a
         request still undispatched that much later completes with
         :class:`DeadlineExpired` instead of occupying a batch slot.
@@ -602,7 +654,14 @@ class SolveService:
         ``request_id`` keys idempotent resubmission (the same id
         always returns the same ticket, in flight or resolved).
         Without one, ``request_id`` raises: accepting it while
-        providing no dedupe would be a silent correctness lie."""
+        providing no dedupe would be a silent correctness lie.
+
+        ``tenant`` tags the request for quota/fair-share scheduling
+        and per-tenant attribution (``None`` = the shared
+        :data:`~porqua_tpu.serve.tenancy.DEFAULT_TENANT` lane). A
+        tenant at its admission quota sheds HERE with
+        :class:`QueueFull` — its burst fills its own bounded
+        sub-queue, never the other tenants' dispatch slots."""
         # Checked here, not only in _submit_raw: on the retry path a
         # raw-submit RuntimeError would be swallowed as a retryable
         # attempt failure and scheduled onto a timer thread that was
@@ -613,24 +672,49 @@ class SolveService:
         if self._retry is not None:
             return self._retry.submit(qp, deadline_s=deadline_s,
                                       warm_key=warm_key, timeout=timeout,
-                                      request_id=request_id)
+                                      request_id=request_id,
+                                      tenant=tenant)
         if request_id is not None:
             raise ValueError(
                 "request_id requires a retry policy "
                 "(SolveService(retry=RetryPolicy(...))): idempotent "
                 "resubmission is tracked by the RetryManager registry")
         return self._submit_raw(qp, deadline_s=deadline_s,
-                                warm_key=warm_key, timeout=timeout)
+                                warm_key=warm_key, timeout=timeout,
+                                tenant=tenant)
+
+    def _shed(self, tenant: str, reason: str, detail: str,
+              trace_id=None, bucket=None) -> None:
+        """Count + report one shed request, then raise QueueFull."""
+        self.metrics.inc("rejected")
+        self.metrics.inc_tenant(tenant, "rejected")
+        if self.obs is not None:
+            self.obs.events.emit(
+                "backpressure_reject", "warn", trace_id=trace_id,
+                tenant=tenant, reason=reason,
+                **({} if bucket is None else {"bucket": bucket}))
+        raise QueueFull(detail) from None
 
     def _submit_raw(self,
                     qp: CanonicalQP,
                     deadline_s: Optional[float] = None,
                     warm_key: Optional[str] = None,
-                    timeout: Optional[float] = None) -> Ticket:
+                    timeout: Optional[float] = None,
+                    tenant: Optional[str] = None) -> Ticket:
         """The raw admission path (one queue entry per call — the
-        retry layer fans its attempts into this)."""
+        retry layer fans its attempts into this). Per-tenant quota is
+        enforced here, BEFORE the shared queue: a tenant's burst sheds
+        at its own bounded sub-queue and cannot displace other
+        tenants' requests from the physical queue."""
         if not self._started:
             raise RuntimeError("service not started (use `with service:`)")
+        tenant = str(tenant) if tenant is not None else DEFAULT_TENANT
+        if not self.admission.try_admit(tenant):
+            self._shed(
+                tenant, "tenant_quota",
+                f"tenant {tenant!r} at its admission quota "
+                f"({self.admission.quota_for(tenant)} queued); shed "
+                f"load or raise its tenant_quota")
         t0 = time.monotonic()
         if _faults.enabled():
             # serve.admission seam: queue_stall sleeps the submitter
@@ -657,24 +741,25 @@ class SolveService:
             qp=padded, bucket=bucket, n_orig=qp.n, m_orig=qp.m,
             future=Future(), submitted=now,
             deadline=None if deadline_s is None else now + deadline_s,
-            warm_key=warm_key, warm_src=warm_src, trace_id=trace_id)
+            warm_key=warm_key, warm_src=warm_src, trace_id=trace_id,
+            tenant=tenant)
         try:
             if timeout is None:
                 self.batcher.queue.put(req)
             else:
                 self.batcher.queue.put(req, timeout=timeout)
         except _queue.Full:
-            self.metrics.inc("rejected")
-            if self.obs is not None:
-                self.obs.events.emit(
-                    "backpressure_reject", "warn", trace_id=trace_id,
-                    queue_capacity=self.batcher.queue.maxsize,
-                    bucket=f"{bucket.n}x{bucket.m}")
-            raise QueueFull(
+            # The admitted slot never reaches a pending queue, so the
+            # dequeue-side release can never fire for it.
+            self.admission.release(tenant)
+            self._shed(
+                tenant, "queue_capacity",
                 f"submission queue at capacity "
                 f"({self.batcher.queue.maxsize}); shed load or raise "
-                f"queue_capacity") from None
+                f"queue_capacity",
+                trace_id=trace_id, bucket=f"{bucket.n}x{bucket.m}")
         self.metrics.inc("submitted")
+        self.metrics.inc_tenant(tenant, "submitted")
         if self.obs is not None:
             # The submit span covers fingerprint + bucket-pad + enqueue;
             # its end abuts `submitted`, so a request's spans (submit ->

@@ -127,6 +127,26 @@ RULES = [
     ("serving_bytes_drift",
      "config_serving.cost_summary.bytes_accessed_max",
      "rel_band", 0.10, "cost"),
+    # -- tenancy: fairness / isolation invariants ----------------------
+    # Multi-tenant artifacts (TENANT_rNN.json — serve_loadgen
+    # --tenants reports) carry a tenant_fairness block; these are
+    # baseline-independent bars enforced whenever the candidate has
+    # it (single-tenant BENCH artifacts skip). quiet_p99_ratio: the
+    # NON-offending tenants' p99s must agree within 4x however hard
+    # the offender bursts (DRR bounds a victim's queue wait by tenant
+    # count, not burst depth). victim_shed_share: quota sheds land
+    # ONLY on the offender — a single victim shed fails.
+    # nonoffender_alerts: the offender's burn fires its own engines
+    # and nobody else's. harvest_reconciled: per-tenant completed ==
+    # per-tenant SolveRecords, exactly.
+    ("tenant_quiet_p99_ratio", "tenant_fairness.quiet_p99_ratio",
+     "le", 4.0, "fairness"),
+    ("tenant_victim_shed_share", "tenant_fairness.victim_shed_share",
+     "le", 0.0, "fairness"),
+    ("tenant_alert_isolation", "tenant_fairness.nonoffender_alerts",
+     "eq", 0, "fairness"),
+    ("tenant_reconciliation", "tenant_fairness.harvest_reconciled",
+     "eq", 1, "fairness"),
 ]
 
 #: Ratio tolerances scaled by --tolerance-scale (invariants never are).
@@ -362,7 +382,10 @@ def _selftest() -> int:
     good["config_serving"]["throughput_solves_per_s"] *= 0.92
     v_good = check_payload(base, good)
     assert v_good["ok"], f"selftest: clean payload failed: {v_good['failed']}"
-    assert v_good["n_skip"] == 0, v_good
+    # The only skips on a full single-tenant payload are the fairness
+    # rules (they apply to multi-tenant TENANT_rNN artifacts).
+    assert all(c["class"] == "fairness" for c in v_good["checks"]
+               if c["status"] == "skip"), v_good
 
     # A synthetically regressed payload: speedup and throughput
     # halved, a steady-state recompile, bit-parity broken, XLA cost
@@ -405,6 +428,33 @@ def _selftest() -> int:
     v_lossy = check_payload(base, lossy)
     assert not v_lossy["ok"] and "serving_throughput" in v_lossy["failed"], \
         v_lossy["failed"]
+
+    # Fairness cells: a multi-tenant report (TENANT_rNN shape) with
+    # clean isolation passes every fairness rule; a noisy-neighbor
+    # breach — victims shedding, a victim's alert firing, per-tenant
+    # reconciliation broken — fails exactly those rules. Artifacts
+    # WITHOUT the block (every BENCH payload) skip them.
+    fair_good = {"tenant_fairness": {
+        "tenants": 3, "quiet_p99_ratio": 1.1,
+        "victim_shed_share": 0.0, "offender_alerts": 1,
+        "nonoffender_alerts": 0, "harvest_reconciled": 1}}
+    v_fair = check_payload({}, fair_good)
+    assert v_fair["ok"], v_fair["failed"]
+    assert not any(c["class"] == "fairness" and c["status"] != "pass"
+                   for c in v_fair["checks"]), v_fair["checks"]
+    fair_bad = {"tenant_fairness": {
+        "tenants": 3, "quiet_p99_ratio": 9.0,
+        "victim_shed_share": 0.12, "offender_alerts": 1,
+        "nonoffender_alerts": 2, "harvest_reconciled": 0}}
+    v_fair_bad = check_payload({}, fair_bad)
+    assert not v_fair_bad["ok"]
+    for name in ("tenant_quiet_p99_ratio", "tenant_victim_shed_share",
+                 "tenant_alert_isolation", "tenant_reconciliation"):
+        assert name in v_fair_bad["failed"], v_fair_bad["failed"]
+    # Single-tenant payloads skip the fairness class entirely.
+    assert all(c["status"] == "skip" for c in
+               check_payload(base, good)["checks"]
+               if c["class"] == "fairness")
 
     # Trend cells: the SAME rule table gating against the rolling
     # median of a synthetic ledger. A candidate hovering at the

@@ -47,6 +47,13 @@ Usage::
 ``serve_loadgen.py --chaos NAME`` replays one scenario under sustained
 load (throughput/latency view, no invariant gating); this suite is the
 correctness gate. See README "Resilience & chaos testing".
+
+The full matrix additionally runs the multi-tenant isolation cells
+(``noisy_neighbor``, ``tenant_feed_corrupt`` — implemented in
+``scripts/tenant_smoke.py``): the victim tenant's SLOs must stay
+compliant while the offender's tenant-labeled burn-rate alert fires
+and the cell's single incident bundle carries the offending tenant id
+(README "Multi-tenant serving & workload library").
 """
 
 from __future__ import annotations
@@ -111,6 +118,14 @@ SCENARIOS = {
 }
 
 MODES = ("classic", "continuous")
+
+#: Multi-tenant isolation cells (scripts/tenant_smoke.py implements
+#: them; the full matrix runs them next to the fault scenarios): the
+#: noisy-neighbor quota burst and the one-tenant feed_corrupt stream,
+#: each asserting the victim tenant's SLOs stay compliant while the
+#: offender's tenant-labeled alert fires and the single incident
+#: bundle carries the offending tenant id.
+TENANT_CELLS = ("noisy_neighbor", "tenant_feed_corrupt")
 
 #: The CI smoke (`--selftest`): one raising seam, one corruption seam
 #: riding the validation gate, and one continuous-mode run.
@@ -454,13 +469,14 @@ def main(argv=None) -> int:
     if args.selftest:
         cells = list(SELFTEST)
     else:
-        names = (list(SCENARIOS) if args.scenarios is None
+        names = (list(SCENARIOS) + list(TENANT_CELLS)
+                 if args.scenarios is None
                  else [s.strip() for s in args.scenarios.split(",") if s])
         modes = [m.strip() for m in args.modes.split(",") if m]
         for s in names:
-            if s not in SCENARIOS:
+            if s not in SCENARIOS and s not in TENANT_CELLS:
                 ap.error(f"unknown scenario {s!r} (known: "
-                         f"{', '.join(SCENARIOS)})")
+                         f"{', '.join(list(SCENARIOS) + list(TENANT_CELLS))})")
         for m in modes:
             if m not in MODES:
                 ap.error(f"unknown mode {m!r} (known: {', '.join(MODES)})")
@@ -477,6 +493,18 @@ def main(argv=None) -> int:
     t0 = time.time()
     results = []
     for name, mode in cells:
+        if name in TENANT_CELLS:
+            # Multi-tenant isolation cells: own service per cell
+            # (per-tenant quotas/SLO engines are construction-time
+            # wiring), implemented in scripts/tenant_smoke.py.
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            from tenant_smoke import run_tenant_cell
+
+            verdict = run_tenant_cell(name, mode=mode, seed=args.seed,
+                                      verbose=True)
+            verdict["scenario"] = verdict.pop("cell")
+            results.append(verdict)
+            continue
         results.append(run_scenario(name, mode, args.seed, qps, refs,
                                     params, ladder, cache, verbose=True))
     report = {

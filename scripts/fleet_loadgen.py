@@ -115,9 +115,29 @@ def _worker_run(cfg: dict) -> None:
     # Every worker builds the SAME deterministic global request pool
     # (seeded synthetic universe) and replays it by global arrival
     # index — the shard is defined by the schedule, not the data.
-    pool = build_tracking_requests(
-        int(cfg["pool"]), n_assets=int(cfg["n_assets"]),
-        window=int(cfg["window"]), seed=int(cfg["seed"]))
+    # With --tenants, the pool is a seeded multi-tenant workload blend
+    # (porqua_tpu.serve.workloads): one global arrival stream of
+    # (offset, tenant, qp) sharded k % N exactly like the grid.
+    blend = None
+    tenant_set = None
+    tenant_kwargs = {}
+    if cfg.get("tenant_spec"):
+        from porqua_tpu.obs.slo import TenantSLOSet
+        from porqua_tpu.serve.workloads import (
+            build_blend, parse_tenant_specs)
+
+        blend = build_blend(parse_tenant_specs(cfg["tenant_spec"]),
+                            duration_s=duration_s,
+                            seed=int(cfg["seed"]))
+        pool = blend.requests
+        tenant_set = TenantSLOSet()
+        tenant_kwargs = dict(tenant_quota=blend.quota_map(),
+                             tenant_weights=blend.weight_map(),
+                             tenant_slos=tenant_set)
+    else:
+        pool = build_tracking_requests(
+            int(cfg["pool"]), n_assets=int(cfg["n_assets"]),
+            window=int(cfg["window"]), seed=int(cfg["seed"]))
 
     obs = Observability()
     # Forward every structured event into the worker stream: the fleet
@@ -133,12 +153,20 @@ def _worker_run(cfg: dict) -> None:
         max_batch=int(cfg["max_batch"]),
         max_wait_ms=float(cfg["max_wait_ms"]),
         queue_capacity=max(4 * int(cfg["max_batch"]), 1024),
-        obs=obs, harvest=sink, continuous=bool(cfg.get("continuous")))
+        obs=obs, harvest=sink, continuous=bool(cfg.get("continuous")),
+        **tenant_kwargs)
     service.start()
     try:
-        n_compiled = service.prewarm(pool[0])
+        # One prewarm per DISTINCT bucket (a tenant blend mixes
+        # tracking/LAD/turnover shapes; the classic pool is one) —
+        # shared helper with run_loadgen so warmup semantics can't
+        # drift between the drivers.
+        from porqua_tpu.serve.loadgen import prewarm_buckets
+
+        n_compiled, warm_examples = prewarm_buckets(service, pool)
         warm = [service.submit(q)
                 for q in pool[:min(len(pool), int(cfg["max_batch"]))]]
+        warm += [service.submit(q) for q in warm_examples]
         for t in warm:
             service.result(t, timeout=300)
         service.metrics.reset_window()
@@ -169,13 +197,19 @@ def _worker_run(cfg: dict) -> None:
         next_emit = t0 + emit_interval_s
 
         def emit_sample() -> None:
+            snapshot = service.snapshot()
+            snap = {kk: vv for kk, vv in snapshot.items()
+                    if kk in ("submitted", "rejected", "batches",
+                              "compiles", "warm_hits", "expired",
+                              "occupancy_mean")}
+            if snapshot.get("tenants"):
+                # The collector's per-tenant merge surface
+                # (fleet-wide tenant counters + labeled gauges).
+                snap["tenants"] = snapshot["tenants"]
             stream.sample(
                 service.metrics.slo_sample(),
                 hist=service.metrics.histograms(),
-                snap={kk: vv for kk, vv in service.snapshot().items()
-                      if kk in ("submitted", "rejected", "batches",
-                                "compiles", "warm_hits", "expired",
-                                "occupancy_mean")},
+                snap=snap,
                 vitals=process_vitals(
                     queue_depth=service.batcher.queue.qsize()))
 
@@ -187,12 +221,18 @@ def _worker_run(cfg: dict) -> None:
                 emit_sample()
                 next_emit += emit_interval_s
                 continue
-            # Global schedule: arrival k fires at k/rate; this worker
-            # owns exactly the k ≡ idx (mod N) slice of it.
-            due = t0 + k / rate
+            # Global schedule: arrival k fires at k/rate (or at the
+            # blend's k-th workload-shaped offset); this worker owns
+            # exactly the k ≡ idx (mod N) slice of it. An exhausted
+            # blend idles to the deadline so sampling keeps flowing.
+            if blend is not None:
+                due = (deadline if k >= len(blend)
+                       else t0 + float(blend.offsets[k]))
+            else:
+                due = t0 + k / rate
             if due > now:
-                time.sleep(min(due - now, next_emit - now,
-                               deadline - now))
+                time.sleep(max(min(due - now, next_emit - now,
+                                   deadline - now), 0.0))
                 continue
             if _faults.enabled():
                 try:
@@ -204,12 +244,18 @@ def _worker_run(cfg: dict) -> None:
                     # collector's liveness tracking exists for.
                     sys.stderr.flush()
                     os._exit(CRASH_EXIT)
-            qp = pool[k % len(pool)]
+            qp = blend.requests[k] if blend is not None \
+                else pool[k % len(pool)]
             try:
                 # Open-loop: never block on a full queue — a stalled
                 # service must show as dropped arrivals, not as a
-                # silently degraded arrival rate.
-                service.submit(qp, timeout=0.0)
+                # silently degraded arrival rate. (A tenant-quota shed
+                # raises the same QueueFull and is additionally
+                # counted on the tenant's own rejected series.)
+                service.submit(
+                    qp, timeout=0.0,
+                    tenant=(blend.tenants[k] if blend is not None
+                            else None))
             except QueueFull:
                 dropped += 1
             k += n_workers
@@ -225,6 +271,9 @@ def _worker_run(cfg: dict) -> None:
             time.sleep(0.05)
         emit_sample()
 
+        if tenant_set is not None:
+            tenant_set.evaluate()
+            emit_sample()  # the final per-tenant counters must land
         snap = service.snapshot()
         measured = time.perf_counter() - t0
         status_counts = {kk[len("status_"):]: vv
@@ -297,7 +346,8 @@ def run_fleet(workers: int = 4,
               crash_seed: int = 0,
               port=None,
               platform=None,
-              events_out=None) -> dict:
+              events_out=None,
+              tenants=None) -> dict:
     """Run one fleet soak; returns the merged fleet report (see
     module docstring for the moving parts)."""
     from porqua_tpu.obs import FlightRecorder, SLOEngine, default_slos
@@ -361,6 +411,7 @@ def run_fleet(workers: int = 4,
             "emit_interval_s": float(emit_interval_s),
             "drain_s": float(drain_s),
             "platform": platform,
+            "tenant_spec": tenants,
         }
         if crash_worker is not None and int(crash_worker) == i:
             cfg["crash_after_s"] = float(crash_after_s
@@ -424,6 +475,8 @@ def run_fleet(workers: int = 4,
                              for p in procs}
     report["crash_worker"] = (None if crash_worker is None
                               else f"w{int(crash_worker)}")
+    if tenants:
+        report["tenant_spec"] = tenants
     if http_port is not None:
         report["http_port"] = http_port
     # Exactly-one-incident accounting for the crash cell: the
@@ -493,6 +546,27 @@ def _selftest_units() -> None:
         merged = col.slo_sample()
         assert merged["completed"] == 30 and merged["failed"] == 1, merged
         assert merged["latency_counts"] == (21, 9, 1), merged
+        # Per-tenant merge: tenant counters sum across workers into
+        # the fleet snapshot + labeled tenant gauges (latency
+        # percentiles deliberately never merge).
+        streams["w0"].sample(
+            sample(10, 1, [6, 4, 1]),
+            snap={"tenants": {"alpha": {"completed": 7, "rejected": 1,
+                                        "latency_p99_ms": 9.0}}})
+        streams["w1"].sample(
+            sample(20, 0, [15, 5, 0]),
+            snap={"tenants": {"alpha": {"completed": 3},
+                              "beta": {"completed": 20}}})
+        col.drain()
+        ften = col.snapshot()["tenants"]
+        assert ften["alpha"]["completed"] == 10, ften
+        assert ften["alpha"]["rejected"] == 1, ften
+        assert ften["beta"]["completed"] == 20, ften
+        assert "latency_p99_ms" not in ften["alpha"], ften
+        gauges = col.worker_gauges()
+        assert ("tenant_completed" in gauges
+                and ({"tenant": "beta"}, 20.0)
+                in gauges["tenant_completed"]), gauges
         # Namespacing: the worker's trace id arrives prefixed.
         evs = col.events.events("breaker_open")
         assert len(evs) == 1 and evs[0]["trace_id"] == "w1/abc", evs
@@ -633,6 +707,12 @@ def main() -> int:
                          "worker W (the worker-failure chaos cell)")
     ap.add_argument("--crash-after-s", type=float, default=None)
     ap.add_argument("--crash-seed", type=int, default=0)
+    ap.add_argument("--tenants", default=None, metavar="SPEC",
+                    help="multi-tenant workload blend spec (same "
+                         "syntax as serve_loadgen.py --tenants): each "
+                         "worker replays its k %% N shard of ONE "
+                         "seeded blend; the fleet report and /metrics "
+                         "gain merged per-tenant series")
     ap.add_argument("--port", type=int, default=None,
                     help="serve the fleet /metrics+/healthz here "
                          "(0 = ephemeral)")
@@ -660,7 +740,8 @@ def main() -> int:
         slo_latency_target_s=args.slo_latency_target,
         crash_worker=args.crash_worker,
         crash_after_s=args.crash_after_s, crash_seed=args.crash_seed,
-        port=args.port, events_out=args.events_out)
+        port=args.port, events_out=args.events_out,
+        tenants=args.tenants)
     if args.ledger:
         from porqua_tpu.obs import ledger as _ledger
 

@@ -39,8 +39,17 @@ def render_table(agg: Dict[str, Any]) -> str:
         f"({agg['ring_records']} with ring trajectories)",
         "sources: " + ", ".join(f"{k} x{v}"
                                 for k, v in sorted(agg["sources"].items())),
+    ]
+    tenants = agg.get("tenants")
+    if tenants:
+        # Schema v2: per-(tenant, bucket, eps) groups. v1 datasets
+        # read back with the legacy sentinel tenant.
+        lines.append("tenants: " + ", ".join(
+            f"{k} x{v}" for k, v in sorted(tenants.items())))
+    lines += [
         "",
-        f"{'bucket':<12} {'eps_abs':>9} {'count':>6} {'p50':>6} "
+        f"{'tenant':<14} {'bucket':<12} {'eps_abs':>9} {'count':>6} "
+        f"{'p50':>6} "
         f"{'p95':>6} {'max':>6} {'wasted':>7} {'warm':>5} {'cold':>5} "
         f"{'w-c iters':>9}  status",
     ]
@@ -50,7 +59,7 @@ def render_table(agg: Dict[str, Any]) -> str:
         status = ",".join(f"{k}:{v}"
                           for k, v in sorted(g["status_counts"].items()))
         lines.append(
-            f"{g['bucket']:<12} "
+            f"{g.get('tenant', '-'):<14} {g['bucket']:<12} "
             f"{(f'{eps:.0e}' if eps is not None else '-'):>9} "
             f"{g['count']:>6} {g['iters']['p50']:>6.0f} "
             f"{g['iters']['p95']:>6.0f} {g['iters']['max']:>6.0f} "
@@ -102,8 +111,19 @@ def _selftest() -> int:
     assert tight["warm_minus_cold_iters_mean"] < 0, tight
     assert tight["iters"]["max"] == 500.0, tight
 
-    text = render_table(agg)
-    for needle in ("32x4", "512x4", "1e-05", "serve x16", "batch x8"):
+    # Tenant axis (schema v2): tagged records group per (tenant,
+    # bucket, eps); untagged producers land on the "default" lane.
+    tagged = records + [solve_record(
+        "serve", 24, 1, 1, 30, 1e-4, 1e-4, -1.0, params=p_loose,
+        bucket="32x4", tenant="fund-a")]
+    agg2 = aggregate(tagged)
+    assert agg2["tenants"] == {"default": 24, "fund-a": 1}, agg2["tenants"]
+    keys = {(g["tenant"], g["bucket"]) for g in agg2["groups"]}
+    assert ("fund-a", "32x4") in keys and ("default", "32x4") in keys
+
+    text = render_table(agg2)
+    for needle in ("32x4", "512x4", "1e-05", "serve x17", "batch x8",
+                   "fund-a", "tenants: default x24, fund-a x1"):
         assert needle in text, f"selftest: {needle!r} missing:\n{text}"
     print(text)
     print("\nharvest_report selftest: ok")

@@ -225,9 +225,42 @@ def _selftest() -> int:
                 "firing": [], "alerts_fired": 1},
         "vitals_anomalous": ["w1/rss_bytes"],
     }
+    # A synthetic multi-tenant loadgen report (the serve_loadgen
+    # --tenants shape): one bursting offender shed at its quota with
+    # its own alert fired, two compliant quiet tenants, exact
+    # per-tenant harvest reconciliation.
+    tenant_report = {
+        "tenants": {
+            "alpha": {"submitted": 600, "completed": 600, "rejected": 0,
+                      "expired": 0, "failed": 0, "latency_p50_ms": 4.0,
+                      "latency_p99_ms": 9.1},
+            "beta": {"submitted": 300, "completed": 300, "rejected": 0,
+                     "expired": 0, "failed": 0, "latency_p50_ms": 4.4,
+                     "latency_p99_ms": 10.2},
+            "gamma": {"submitted": 2000, "completed": 900,
+                      "rejected": 1100, "expired": 0, "failed": 0,
+                      "latency_p50_ms": 6.0, "latency_p99_ms": 30.0},
+        },
+        "tenant_slo": {"alpha": {"alerts_fired": 0},
+                       "beta": {"alerts_fired": 0},
+                       "gamma": {"alerts_fired": 1}},
+        "tenant_fairness": {
+            "offenders": ["gamma"], "tenants": 3,
+            "quiet_p99_ratio": 1.12, "victim_shed_share": 0.0,
+            "offender_alerts": 1, "nonoffender_alerts": 0,
+            "harvest_reconciled": 1,
+        },
+    }
     text = render_report(trace=trace, events=events, snapshot=snapshot,
-                         harvest=harvest, costs=costs, fleet=fleet)
-    for needle in ("fleet workers (3)",
+                         harvest=harvest, costs=costs, fleet=fleet,
+                         tenants=tenant_report)
+    for needle in ("tenants (3)",
+                   "gamma",
+                   "quiet p99 ratio 1.12",
+                   "alerts offender=1 / others=0",
+                   "isolation: OK",
+                   "per-tenant reconciliation: exact",
+                   "fleet workers (3)",
                    "worker liveness: 2 ok, 1 lost",
                    "LOST: w2",
                    "1 worker_lost incident bundle",
@@ -282,6 +315,11 @@ def main() -> int:
                     help="merged fleet report JSON (fleet_loadgen "
                          "--out): per-worker table, reconciliation + "
                          "liveness verdicts, SLO summary")
+    ap.add_argument("--tenants", default=None, metavar="REPORT",
+                    help="multi-tenant loadgen report JSON "
+                         "(serve_loadgen --tenants ... --out, e.g. "
+                         "TENANT_r11.json): per-tenant table + "
+                         "fairness/isolation verdict")
     ap.add_argument("--selftest", action="store_true",
                     help="render a synthetic run and verify the pipeline")
     args = ap.parse_args()
@@ -293,9 +331,13 @@ def main() -> int:
         load_cost_records, load_harvest, load_jsonl, render_report)
 
     trace = events = snapshot = harvest = costs = fleet = None
+    tenants = None
     if args.fleet:
         with open(args.fleet) as f:
             fleet = json.load(f)
+    if args.tenants:
+        with open(args.tenants) as f:
+            tenants = json.load(f)
     if args.trace:
         with open(args.trace) as f:
             trace = json.load(f)
@@ -310,7 +352,8 @@ def main() -> int:
         costs = load_cost_records(args.costs)
 
     print(render_report(trace=trace, events=events, snapshot=snapshot,
-                        harvest=harvest, costs=costs, fleet=fleet))
+                        harvest=harvest, costs=costs, fleet=fleet,
+                        tenants=tenants))
     return 0
 
 

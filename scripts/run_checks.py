@@ -12,7 +12,9 @@ live operational plane ``obs/slo.py`` / ``obs/flight.py`` /
 ``obs/federation.py`` / ``obs/vitals.py`` / ``obs/ledger.py`` among
 it), the compaction driver
 ``porqua_tpu/compaction.py``, the continuous batcher
-``porqua_tpu/serve/continuous.py``, and the resilience plane
+``porqua_tpu/serve/continuous.py``, the tenancy plane
+``porqua_tpu/serve/tenancy.py`` and workload library
+``porqua_tpu/serve/workloads.py``, and the resilience plane
 ``porqua_tpu/resilience/`` (all of which must scan
 clean with zero suppressions, same bar as the solver) — with every AST rule
 (GC001-GC010; GC007 enforces the ``if faults.enabled():`` guard on
@@ -46,7 +48,14 @@ raw histograms merged, a worker lost to the liveness deadline with
 its incident bundle dumped, a vitals leak trended to firing, a
 ledger row round-tripped — leaves the solve/serve jaxprs string-
 identical: the whole fleet observability plane is host file/dict
-code). Exit status: 0 clean, 1 findings, 2 internal/usage error.
+code), and the GC109 tenancy-identity contract (the tenant plane
+fully exercised — a quota shed, a deficit-round-robin interleave
+across a burst backlog, a tenant-labeled per-tenant burn-rate alert
+fired on a stepped clock, a tenant-tagged harvest record, a seeded
+three-tenant workload blend — leaves the solve/serve jaxprs
+string-identical: tenancy is host-side scheduling + attribution
+only, and no compiled program carries a tenant). Exit status: 0
+clean, 1 findings, 2 internal/usage error.
 
 Options:
     --format {text,json}   output format (default text)
@@ -120,7 +129,7 @@ def main(argv=None) -> int:
     if not args.no_contracts and (
             rules is None or rules & {"GC101", "GC102", "GC103", "GC104",
                                       "GC105", "GC106", "GC107",
-                                      "GC108"}):
+                                      "GC108", "GC109"}):
         try:
             import jax
 

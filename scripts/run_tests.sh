@@ -70,6 +70,33 @@ else
     fail=1
 fi
 
+# workload library: seeded-deterministic arrival traces + blend-share
+# reconciliation (no JAX backend) — the production-shaped traffic
+# generators every multi-tenant claim is measured against (README
+# "Multi-tenant serving & workload library").
+if out=$(timeout 300 python scripts/serve_loadgen.py --workloads-selftest 2>&1); then
+    echo "OK   workloads --selftest: $(echo "$out" | tail -1)"
+else
+    echo "FAIL workloads --selftest:"
+    echo "$out"
+    fail=1
+fi
+
+# tenant smoke: the 2-tenant noisy-neighbor isolation cell against a
+# live SolveService — the offender floods 10x past its quota and must
+# shed at its OWN sub-queue and fire its OWN tenant-labeled SLO alert
+# (one incident bundle) while the victim sheds nothing, misses no
+# deadline, and stays SLO-compliant. The full multi-tenant cell set
+# (incl. tenant_feed_corrupt, both serve modes): scripts/tenant_smoke.py
+# --all / chaos_suite.py.
+if out=$(timeout 600 env JAX_PLATFORMS=cpu python scripts/tenant_smoke.py 2>&1); then
+    echo "OK   tenant_smoke: $(echo "$out" | tail -1)"
+else
+    echo "FAIL tenant_smoke:"
+    echo "$out"
+    fail=1
+fi
+
 # fleet_loadgen: the federation plane — a no-JAX collector unit pass
 # (merge / reconciliation / liveness / rollup bounds / namespacing /
 # ladder refusal) plus a real 2-worker ~10 s mini-soak on XLA-CPU

@@ -33,6 +33,14 @@ Examples::
                                    # device-truth CostRecords + a bounded
                                    # jax.profiler trace; rank fusion
                                    # targets: scripts/roofline_report.py
+    python scripts/serve_loadgen.py --duration-s 60 --tenants \\
+        "alpha:tracking:diurnal:rate=40;beta:lad:heavy_tailed:rate=15;\\
+gamma:tracking:bursty:rate=8,burst_factor=10,offender=1,quota=64" \\
+        --out tenant_report.json    # mixed-tenant production-shaped
+                                   # blend (porqua_tpu.serve.workloads):
+                                   # per-tenant quotas/DRR/SLO engines,
+                                   # fairness block gated by bench_gate;
+                                   # render: obs_report.py --tenants
 
 Prints one JSON report line on stdout (diagnostics on stderr), in the
 same one-line-artifact style as ``bench.py``.
@@ -50,8 +58,45 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--workload", choices=("grid", "northstar"),
-                    default="grid")
+    ap.add_argument("--workload", choices=("grid", "northstar", "mixed"),
+                    default="grid",
+                    help="grid/northstar: the classic single-tenant "
+                         "streams; mixed: a multi-tenant blend from "
+                         "--tenants (porqua_tpu.serve.workloads)")
+    ap.add_argument("--tenants", default=None, metavar="SPEC",
+                    help="mixed-workload tenant spec, ';'-separated "
+                         "name:problem:arrival[:key=value,...] — e.g. "
+                         "'alpha:tracking:diurnal:rate=40;"
+                         "beta:lad:heavy_tailed:rate=15;"
+                         "gamma:tracking:bursty:rate=8,burst_factor=10,"
+                         "offender=1,quota=64' (problems: tracking|lad|"
+                         "turnover; arrivals: steady|diurnal|bursty|"
+                         "heavy_tailed). Implies --workload mixed, "
+                         "open-loop blend arrivals, per-tenant quotas/"
+                         "weights from the spec, and per-tenant SLO "
+                         "engines")
+    ap.add_argument("--duration-s", type=float, default=60.0,
+                    help="mixed-workload blend duration (the arrival "
+                         "trace window)")
+    ap.add_argument("--tenant-latency-target", type=float, default=0.25,
+                    metavar="S",
+                    help="per-tenant latency-SLO target seconds for "
+                         "the --tenants run (XLA-CPU continuous "
+                         "cohorts want a generous one)")
+    ap.add_argument("--tenant-single-rule", action="store_true",
+                    help="per-tenant SLO engines run ONE burn-rate "
+                         "rule with a run-spanning resolve dwell — a "
+                         "breaching tenant fires exactly one alert "
+                         "(the TENANT_rNN artifact's crisp invariant) "
+                         "instead of the fast+slow default pair")
+    ap.add_argument("--out", default=None, metavar="PATH",
+                    help="also write the report JSON here (the "
+                         "TENANT_rNN artifact shape; render with "
+                         "obs_report.py --tenants)")
+    ap.add_argument("--workloads-selftest", action="store_true",
+                    help="run the workload library's selftest (seeded "
+                         "determinism, blend-share reconciliation — "
+                         "no JAX backend) and exit")
     ap.add_argument("--requests", type=int, default=None,
                     help="request count (default: 2048 grid / 252 northstar)")
     ap.add_argument("--window", type=int, default=252)
@@ -173,15 +218,59 @@ def main() -> int:
                          "QPs do (factored requests bucket separately)")
     args = ap.parse_args()
 
+    if args.workloads_selftest:
+        from porqua_tpu.serve import workloads
+
+        workloads.selftest()
+        print("workloads selftest: ok")
+        return 0
+
     from porqua_tpu.serve.loadgen import build_tracking_requests, run_loadgen
 
-    n_assets = {"grid": 24, "northstar": 500}[args.workload]
-    n_requests = args.requests or {"grid": 2048, "northstar": 252}[args.workload]
-    print(f"building {n_requests} requests "
-          f"(n={n_assets}, window={args.window})...", file=sys.stderr)
-    requests = build_tracking_requests(
-        n_requests, n_assets=n_assets, window=args.window, seed=args.seed,
-        factor=args.factor)
+    tenancy_kwargs = {}
+    if args.tenants:
+        args.workload = "mixed"
+    if args.workload == "mixed":
+        if not args.tenants:
+            ap.error("--workload mixed requires --tenants SPEC")
+        from porqua_tpu.serve.workloads import (
+            build_blend, parse_tenant_specs)
+
+        specs = parse_tenant_specs(args.tenants)
+        blend = build_blend(specs, duration_s=args.duration_s,
+                            seed=args.seed)
+        print(f"building mixed blend: {len(blend)} arrivals over "
+              f"{args.duration_s:g}s, shares {blend.shares()}",
+              file=sys.stderr)
+        requests = blend.requests
+        args.mode = "open"
+        from porqua_tpu.obs import TenantSLOSet
+        from porqua_tpu.obs.slo import (
+            DEFAULT_RULES, BurnRateRule, default_slos)
+
+        rules = DEFAULT_RULES
+        if args.tenant_single_rule:
+            rules = (BurnRateRule(
+                "fast", long_s=3600.0, short_s=300.0, burn_rate=14.4,
+                resolve_s=3600.0),)
+        tenancy_kwargs = dict(
+            arrivals=blend.offsets, tenants=blend.tenants,
+            tenant_quota=blend.quota_map(),
+            tenant_weights=blend.weight_map(),
+            tenant_slos=TenantSLOSet(
+                slos=default_slos(
+                    latency_target_s=args.tenant_latency_target),
+                rules=rules),
+            offenders=blend.offenders())
+    else:
+        n_assets = {"grid": 24, "northstar": 500}[args.workload]
+        n_requests = args.requests or {"grid": 2048,
+                                       "northstar": 252}[args.workload]
+        print(f"building {n_requests} requests "
+              f"(n={n_assets}, window={args.window})...", file=sys.stderr)
+        requests = build_tracking_requests(
+            n_requests, n_assets=n_assets, window=args.window,
+            seed=args.seed, factor=args.factor)
 
     retry = None
     if args.retry or args.hedge_after_s is not None:
@@ -206,8 +295,12 @@ def main() -> int:
         anomaly_baseline=args.anomaly_baseline,
         cost_out=args.cost_out,
         profile_window_s=args.profile_window,
-        profile_dir=args.profile_dir)
+        profile_dir=args.profile_dir,
+        **tenancy_kwargs)
     report["workload"] = args.workload
+    if args.tenants:
+        report["tenant_spec"] = args.tenants
+        report["duration_s"] = args.duration_s
     if args.ledger:
         from porqua_tpu.obs import ledger as _ledger
 
@@ -220,6 +313,10 @@ def main() -> int:
         _ledger.append_row(args.ledger, row)
         report["ledger_row"] = row["run_id"]
     print(json.dumps(report))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1, default=str)
+        print(f"report -> {args.out}", file=sys.stderr)
     # Under --chaos, errors are the scenario doing its job (failed
     # requests are an allowed outcome; wrong answers are not, and the
     # validation gate converts those to errors) — the invariant

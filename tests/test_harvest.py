@@ -24,7 +24,11 @@ from porqua_tpu.obs import (
     qp_solve_profile,
     solve_record,
 )
-from porqua_tpu.obs.harvest import aggregate, harvest_solution
+from porqua_tpu.obs.harvest import (
+    SCHEMA_VERSION,
+    aggregate,
+    harvest_solution,
+)
 from porqua_tpu.obs.profile import chrome_counter_events
 from porqua_tpu.obs.report import harvest_section
 from porqua_tpu.obs.rings import ring_history
@@ -128,7 +132,7 @@ class TestHarvestSink:
         records = load_harvest(path)
         assert len(records) == 400 and sink.records == 400
         # Interleaved writes never tore a line.
-        assert all(r["v"] == 1 for r in records)
+        assert all(r["v"] == SCHEMA_VERSION for r in records)
 
 
 # ---------------------------------------------------------------------------

@@ -317,7 +317,7 @@ class _FakeRawService:
         self.inner = []
 
     def _submit_raw(self, qp, deadline_s=None, warm_key=None,
-                    timeout=None):
+                    timeout=None, tenant=None):
         import time as _time
 
         from concurrent.futures import Future

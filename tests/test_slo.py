@@ -566,7 +566,9 @@ class TestServiceWiring:
         iters = [r.iters for r in results]
         assert max(iters) > min(iters)  # a real spread, else vacuous
         groups = anomaly.status()["groups"]
-        key = "8x4@1e-05"
+        # Untagged serve requests are accounted under the shared
+        # "default" tenant lane since the tenancy plane landed.
+        key = "default/8x4@1e-05"
         assert key in groups
         # Fast lanes paid the straggler's segments: mean waste over
         # the batch must be visibly nonzero (per-lane derivation

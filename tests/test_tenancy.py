@@ -1,0 +1,440 @@
+"""Tenancy plane tests: quotas, DRR fair share, per-tenant metrics/
+SLOs, harvest schema v2 evolution, workload blends, GC109.
+
+The tentpole invariants (README "Multi-tenant serving & workload
+library"): one tenant's burst sheds at its OWN bounded sub-queue and
+cannot starve another tenant's deadline; per-tenant attribution
+(counters, latency histograms, SLO engines, harvest records)
+reconciles exactly; tenancy is host-side only (GC109: the tenant plane
+leaves the solve/serve jaxprs string-identical); and v1 (pre-tenant)
+harvest datasets — the committed ``HARVEST_r07.json`` included — keep
+loading with the legacy sentinel tenant.
+"""
+
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+from porqua_tpu.obs import TenantSLOSet
+from porqua_tpu.obs.anomaly import AnomalyDetector
+from porqua_tpu.obs.events import EventBus
+from porqua_tpu.obs.exposition import prometheus_text
+from porqua_tpu.obs.harvest import (
+    DEFAULT_TENANT,
+    LEGACY_TENANT,
+    SCHEMA_VERSION,
+    HarvestSink,
+    aggregate,
+    load_harvest,
+    solve_record,
+)
+from porqua_tpu.obs.slo import BurnRateRule, default_slos
+from porqua_tpu.qp.canonical import CanonicalQP
+from porqua_tpu.qp.solve import SolverParams
+from porqua_tpu.resilience.faults import FaultClock
+from porqua_tpu.serve import BucketLadder, QueueFull, ServeMetrics, SolveService
+from porqua_tpu.serve.tenancy import FairPendingQueue, TenantAdmission
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PARAMS = SolverParams(max_iter=500, eps_abs=1e-5, eps_rel=1e-5,
+                      polish=False, check_interval=25)
+
+
+def _qp(seed=0, nv=6, m=2):
+    rng = np.random.default_rng(seed)
+    A = rng.standard_normal((2 * nv, nv))
+    P = A.T @ A / (2 * nv) + np.eye(nv)
+    q = rng.standard_normal(nv)
+    C = np.concatenate([np.ones((1, nv)),
+                        rng.standard_normal((m - 1, nv))])
+    return CanonicalQP.build(P, q, C=C, l=np.full(m, -1.0),
+                             u=np.ones(m), lb=np.zeros(nv),
+                             ub=np.ones(nv))
+
+
+class _Req:
+    def __init__(self, tenant, submitted=0.0):
+        self.tenant = tenant
+        self.submitted = float(submitted)
+
+
+# ---------------------------------------------------------------------------
+# tenancy primitives
+# ---------------------------------------------------------------------------
+
+def test_admission_quota_sheds_only_offender():
+    adm = TenantAdmission(quota={"noisy": 3})
+    assert all(adm.try_admit("noisy") for _ in range(3))
+    assert not adm.try_admit("noisy")          # at quota: shed
+    assert all(adm.try_admit("quiet") for _ in range(100))  # unbounded
+    assert adm.depth("noisy") == 3
+    adm.release("noisy")
+    assert adm.try_admit("noisy")              # a release frees a slot
+    assert adm.sheds() == {"noisy": 1}
+
+
+def test_admission_cardinality_bounded_by_overflow_lane():
+    """Tenant ids are caller-supplied strings: past max_tenants, new
+    ids fold into one shared overflow lane so an id-spraying client
+    cannot grow the scheduler dicts (or /healthz depths) without
+    limit. A tenant first seen at capacity maps to the overflow lane
+    on admit AND release."""
+    adm = TenantAdmission(quota={"known": 4}, max_tenants=2)
+    assert adm.try_admit("a") and adm.try_admit("b")
+    for i in range(50):
+        adm.try_admit(f"spray-{i}")
+    assert set(adm.depths()) == {"a", "b", TenantAdmission.OVERFLOW}
+    assert adm.depths()[TenantAdmission.OVERFLOW] == 50
+    adm.release("spray-0")  # releases the overflow lane, not a new key
+    assert adm.depths()[TenantAdmission.OVERFLOW] == 49
+    # Explicitly-configured tenants keep their own lane regardless.
+    assert adm.try_admit("known") and adm.depth("known") == 1
+
+
+def test_admission_int_quota_applies_to_every_tenant():
+    adm = TenantAdmission(quota=2)
+    for t in ("a", "b"):
+        assert adm.try_admit(t) and adm.try_admit(t)
+        assert not adm.try_admit(t)
+    assert adm.depths() == {"a": 2, "b": 2}
+
+
+def test_drr_interleaves_burst_backlog():
+    """A 10-deep burst backlog cannot starve the quiet tenant: at
+    equal weights the dequeue alternates tenants 1:1."""
+    fq = FairPendingQueue()
+    for i in range(10):
+        fq.append(_Req("noisy", i))
+    fq.append(_Req("quiet", 100.0))
+    order = [fq.popleft().tenant for _ in range(4)]
+    assert "quiet" in order[:2], order
+    # Remaining pops drain the noisy backlog.
+    rest = [fq.popleft().tenant for _ in range(len(fq))]
+    assert rest.count("noisy") == len(rest)
+    with pytest.raises(IndexError):
+        fq.popleft()
+
+
+def test_drr_weights_grant_proportional_slots():
+    fq = FairPendingQueue(weights={"heavy": 2.0})
+    for i in range(20):
+        fq.append(_Req("heavy", i))
+        fq.append(_Req("light", i))
+    first = [fq.popleft().tenant for _ in range(12)]
+    assert first.count("heavy") >= 7, first  # ~2:1 service ratio
+
+
+def test_fair_queue_peek_is_oldest_across_tenants():
+    fq = FairPendingQueue()
+    fq.append(_Req("b", 5.0))
+    fq.append(_Req("a", 1.0))
+    assert fq[0].tenant == "a" and fq.oldest_submitted() == 1.0
+    assert len(fq) == 2 and bool(fq)
+
+
+def test_fair_queue_releases_admission_on_every_pop():
+    adm = TenantAdmission(quota=8)
+    fq = FairPendingQueue(admission=adm)
+    for i in range(4):
+        assert adm.try_admit("t")
+        fq.append(_Req("t", i))
+    assert adm.depth("t") == 4
+    for _ in range(4):
+        fq.popleft()
+    assert adm.depth("t") == 0
+
+
+# ---------------------------------------------------------------------------
+# per-tenant metrics + exposition
+# ---------------------------------------------------------------------------
+
+def test_tenant_metrics_counters_and_latency():
+    m = ServeMetrics()
+    m.inc_tenant("a", "submitted", 3)
+    m.inc_tenant("a", "completed", 2)
+    m.inc_tenant("b", "rejected")
+    for s in (0.004, 0.008, 0.120):
+        m.observe_tenant_latency("a", s)
+    m.inc_tenant(None, "completed")  # no-op, no tenant
+    snap = m.snapshot()["tenants"]
+    assert snap["a"]["submitted"] == 3 and snap["a"]["completed"] == 2
+    assert snap["b"]["rejected"] == 1
+    assert snap["a"]["latency_p99_ms"] > 100.0
+    assert None not in snap
+    # The SLO view: sheds count as availability bad events.
+    sample = m.tenant_slo_sample("b")
+    assert sample["failed"] == 1 and sample["completed"] == 0
+    assert m.tenant_view("b").slo_sample() == sample
+    # Window reset clears the tenant axis with everything else.
+    m.reset_window()
+    assert "tenants" not in m.snapshot()
+
+
+def test_tenant_cardinality_bounded_by_overflow_lane():
+    m = ServeMetrics(max_tenants=4)
+    for i in range(10):
+        m.inc_tenant(f"t{i}", "submitted")
+    snap = m.snapshot()["tenants"]
+    assert len(snap) == 5  # 4 real + the overflow lane
+    assert snap[ServeMetrics._TENANT_OVERFLOW]["submitted"] == 6
+
+
+def test_prometheus_escapes_hostile_tenant_label():
+    """Regression (satellite): tenant ids are caller-supplied strings;
+    an unescaped backslash/quote/newline in a label VALUE invalidates
+    the whole scrape per the text exposition format."""
+    m = ServeMetrics()
+    hostile = 'evil"tenant\\with\nnewline'
+    m.inc_tenant(hostile, "completed", 2)
+    text = prometheus_text(m.snapshot(),
+                           labeled_gauges=m.tenant_labeled_gauges())
+    line = next(ln for ln in text.splitlines()
+                if ln.startswith("porqua_serve_tenant_completed{"))
+    assert '\\"' in line and "\\\\" in line and "\\n" in line, line
+    # The raw control characters must NOT survive into the exposition:
+    # every emitted line is exactly one series.
+    assert "\n" not in line and line.endswith(" 2")
+    # And every value round-trips through the documented unescaping.
+    label = line.split("{", 1)[1].rsplit("}", 1)[0]
+    value = label.split('="', 1)[1][:-1]
+    unescaped = (value.replace("\\n", "\n").replace('\\"', '"')
+                 .replace("\\\\", "\\"))
+    assert unescaped == hostile
+
+
+# ---------------------------------------------------------------------------
+# per-tenant SLO engines
+# ---------------------------------------------------------------------------
+
+def test_tenant_slo_set_fires_only_offender_with_label():
+    clk = FaultClock()
+    m = ServeMetrics()
+    ev = EventBus()
+    ts = TenantSLOSet(
+        slos=default_slos(latency_target_s=5.0),
+        rules=(BurnRateRule("fast", long_s=3600.0, short_s=300.0,
+                            burn_rate=14.4, resolve_s=3600.0),),
+        clock=clk, min_eval_interval_s=0.0).bind(m, events=ev)
+    for t in ("noisy", "quiet"):
+        m.inc_tenant(t, "completed")
+    ts.evaluate()
+    m.inc_tenant("noisy", "completed", 2)
+    m.inc_tenant("noisy", "rejected", 98)   # quota sheds burn budget
+    m.inc_tenant("quiet", "completed", 100)
+    clk.advance(10.0)
+    ts.evaluate()
+    fired = ts.alerts_fired()
+    assert fired["noisy"] == 1 and fired["quiet"] == 0, fired
+    alerts = ev.events("slo_alert")
+    assert all(e.get("tenant") == "noisy" for e in alerts), alerts
+    status = ts.status()
+    assert status["quiet"]["slos"]["availability"]["compliance"] == 1.0
+    gauges = ts.labeled_gauges()
+    assert ({"tenant": "noisy"}, 2.0) in \
+        gauges["tenant_slo_alert_state_availability_fast"]
+    counters = ts.counters()
+    assert counters["tenant_slo_engines"] == 2
+    assert counters["tenant_slo_alerts_fired"] == 1
+
+
+def test_tenant_slo_set_bounds_engine_count():
+    m = ServeMetrics()
+    ts = TenantSLOSet(max_tenants=2, min_eval_interval_s=0.0).bind(m)
+    for i in range(5):
+        m.inc_tenant(f"t{i}", "completed")
+    ts.evaluate()
+    assert ts.counters()["tenant_slo_engines"] == 2
+    assert ts.counters()["tenant_slo_overflow"] >= 3
+
+
+# ---------------------------------------------------------------------------
+# harvest schema evolution (satellite)
+# ---------------------------------------------------------------------------
+
+def test_schema_v2_records_carry_tenant():
+    assert SCHEMA_VERSION == 2
+    rec = solve_record("serve", 6, 2, 1, 10, 0.0, 0.0, 0.0)
+    assert rec["v"] == 2 and rec["tenant"] == DEFAULT_TENANT
+    tagged = solve_record("serve", 6, 2, 1, 10, 0.0, 0.0, 0.0,
+                          tenant="fund-a")
+    assert tagged["tenant"] == "fund-a"
+
+
+def test_v1_records_aggregate_under_legacy_sentinel(tmp_path):
+    """A v1 dataset (no tenant field) must keep loading: tenant
+    defaults to the LEGACY_TENANT sentinel, distinguishable from a
+    real v2 'default'-lane record."""
+    path = tmp_path / "v1.jsonl"
+    v1 = {"v": 1, "t": 0.0, "source": "serve", "n": 6, "m": 2,
+          "status": 1, "iters": 50, "prim_res": 1e-6, "dual_res": 1e-6,
+          "obj_val": -1.0, "warm": False, "bucket": "8x4",
+          "eps_abs": 1e-5, "check_interval": 25, "segments": 2}
+    with open(path, "w") as f:
+        f.write(json.dumps(v1) + "\n")
+        f.write(json.dumps(v1) + "\n")
+    records = load_harvest(str(path))
+    agg = aggregate(records)
+    assert agg["tenants"] == {LEGACY_TENANT: 2}
+    (group,) = agg["groups"]
+    assert group["tenant"] == LEGACY_TENANT and group["count"] == 2
+
+
+def test_committed_v1_harvest_r07_still_consumable():
+    """The committed pre-tenant artifact (a schema-v1 AGGREGATE whose
+    groups carry no tenant key) must keep feeding every v2 consumer:
+    the harvest_report renderer and the anomaly baseline builder."""
+    path = os.path.join(_REPO, "HARVEST_r07.json")
+    if not os.path.exists(path):
+        pytest.skip("HARVEST_r07.json not committed")
+    with open(path) as f:
+        agg = json.load(f)
+    assert agg["schema_version"] == 1
+    assert agg["groups"] and all("tenant" not in g
+                                 for g in agg["groups"])
+    # The report renderer consumes the v1 aggregate unchanged (the
+    # tenant column renders the '-' placeholder).
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "harvest_report", os.path.join(_REPO, "scripts",
+                                       "harvest_report.py"))
+    hr = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(hr)
+    text = hr.render_table(agg)
+    assert f"{agg['records']} records" in text
+    # The anomaly baseline builder still calibrates from it — same
+    # (bucket, eps) band set the PR 8 detector shipped with.
+    det = AnomalyDetector.from_aggregate(agg)
+    assert len(det.baseline) == len(agg["groups"])
+    # And a v1 RECORD stream re-aggregated today lands under the
+    # sentinel tenant (pinned structurally in
+    # test_v1_records_aggregate_under_legacy_sentinel; here against
+    # the committed groups' own shape).
+    v1_rec = {"v": 1, "source": "serve", "n": 24, "m": 1, "status": 1,
+              "iters": int(agg["groups"][0]["iters"]["p50"]),
+              "prim_res": 1e-6, "dual_res": 1e-6, "obj_val": -1.0,
+              "bucket": agg["groups"][0]["bucket"],
+              "eps_abs": agg["groups"][0]["eps_abs"]}
+    re_agg = aggregate([v1_rec])
+    assert re_agg["groups"][0]["tenant"] == LEGACY_TENANT
+
+
+def test_tenant_grouping_round_trips(tmp_path):
+    """Per-(tenant, bucket, eps) grouping: two tenants on the same
+    (bucket, eps) keep separate rows, and the anomaly baseline merges
+    them back to one conservative (bucket, eps) band."""
+    path = tmp_path / "v2.jsonl.gz"
+    with HarvestSink(str(path)) as sink:
+        for tenant, iters in (("a", 50), ("a", 60), ("b", 200)):
+            sink.emit(solve_record(
+                "serve", 6, 2, 1, iters, 1e-6, 1e-6, -1.0,
+                bucket="8x4", eps_abs=1e-5, check_interval=25,
+                tenant=tenant))
+    agg = aggregate(load_harvest(str(path)))
+    keys = {(g["tenant"], g["bucket"], g["eps_abs"])
+            for g in agg["groups"]}
+    assert keys == {("a", "8x4", 1e-5), ("b", "8x4", 1e-5)}
+    det = AnomalyDetector.from_aggregate(agg)
+    assert set(det.baseline) == {("8x4", 1e-5)}
+    base = det.baseline[("8x4", 1e-5)]
+    assert base["count"] == 3
+    assert base["iters_p95"] == 200.0  # the widest tenant's band
+
+
+def test_anomaly_detector_tenant_axis():
+    """Online EWMAs split per tenant against the shared baseline: one
+    tenant's drift fires an event naming that tenant; the other
+    tenant's group stays clean."""
+    ev = EventBus()
+    det = AnomalyDetector(
+        {("8x4", 1e-5): {"iters_p50": 50.0, "iters_p95": 100.0,
+                         "iters_max": 150.0, "wasted": 0.1,
+                         "count": 64}},
+        min_samples=4, events=ev)
+    for _ in range(8):
+        det.observe("8x4", 1e-5, iters=5000, segments=200,
+                    check_interval=25, tenant="bad")
+        det.observe("8x4", 1e-5, iters=50, segments=2,
+                    check_interval=25, tenant="good")
+    st = det.status()
+    assert st["fired"] == 1
+    assert st["anomalous"] == ["bad/8x4@1e-05"], st["anomalous"]
+    events = ev.events("convergence_anomaly")
+    assert events and events[0]["tenant"] == "bad"
+
+
+# ---------------------------------------------------------------------------
+# end-to-end service behavior
+# ---------------------------------------------------------------------------
+
+def test_service_quota_shed_and_attribution():
+    """Live service: the offender's overflow sheds with QueueFull at
+    ITS quota, counted on its own series; the victim's traffic is
+    untouched; per-tenant completed == per-tenant harvest records."""
+    sink = HarvestSink(None)
+    service = SolveService(
+        params=PARAMS, ladder=BucketLadder(n_rungs=(8,), m_rungs=(4,)),
+        max_batch=4, max_wait_ms=200.0, queue_capacity=64,
+        tenant_quota={"noisy": 2}, harvest=sink)
+    qp = _qp()
+    with service:
+        service.prewarm(qp)
+        # Stall dispatch long enough (max_wait 200ms, batch 4) that
+        # the noisy tenant's 3rd submit finds its sub-queue full.
+        t1 = service.submit(qp, tenant="noisy")
+        t2 = service.submit(qp, tenant="noisy")
+        with pytest.raises(QueueFull):
+            service.submit(qp, tenant="noisy")
+        t3 = service.submit(qp, tenant="quiet")
+        for t in (t1, t2, t3):
+            service.result(t, timeout=60)
+        snap = service.snapshot()["tenants"]
+        assert snap["noisy"]["rejected"] == 1
+        assert snap["noisy"]["completed"] == 2
+        assert snap["quiet"]["rejected"] == 0
+        assert snap["quiet"]["completed"] == 1
+        counts = {}
+        for rec in sink.buffered():
+            counts[rec["tenant"]] = counts.get(rec["tenant"], 0) + 1
+        assert counts.get("noisy") == 2 and counts.get("quiet") == 1
+        # /healthz carries the tenancy section; /metrics the labeled
+        # tenant series (escaped ids pinned separately).
+        payload = service._health_payload()
+        assert payload["tenancy"]["tenants"]["noisy"]["rejected"] == 1
+        assert payload["tenancy"]["quota_sheds"] == {"noisy": 1}
+        text = prometheus_text(
+            service.snapshot(),
+            labeled_gauges=service._labeled_gauges())
+        assert 'porqua_serve_tenant_rejected{tenant="noisy"} 1' in text
+
+
+def test_untagged_requests_account_under_default_lane():
+    service = SolveService(
+        params=PARAMS, ladder=BucketLadder(n_rungs=(8,), m_rungs=(4,)),
+        max_batch=2, max_wait_ms=2.0, queue_capacity=16)
+    qp = _qp()
+    with service:
+        service.prewarm(qp)
+        service.result(service.submit(qp), timeout=60)
+    snap = service.snapshot()["tenants"]
+    assert snap[DEFAULT_TENANT]["completed"] == 1
+
+
+# ---------------------------------------------------------------------------
+# workloads + contracts
+# ---------------------------------------------------------------------------
+
+def test_workload_library_selftest():
+    from porqua_tpu.serve import workloads
+
+    workloads.selftest()
+
+
+def test_gc109_tenancy_identity_clean():
+    from porqua_tpu.analysis.contracts import check_tenancy_identity
+
+    assert check_tenancy_identity() == []
