@@ -45,6 +45,7 @@ __all__ = [
     "check_entry_points",
     "check_observability_identity",
     "check_resilience_identity",
+    "check_routing_identity",
     "check_run_batch",
     "check_telemetry_identity",
     "check_tenancy_identity",
@@ -770,6 +771,89 @@ def check_tenancy_identity(dtype=np.float32) -> List[Finding]:
     return findings
 
 
+def check_routing_identity(dtype=np.float32) -> List[Finding]:
+    """GC110: solver routing must be invisible to XLA.
+
+    The :class:`porqua_tpu.serve.routing.SolverRouter` promises it is
+    host-side dispatch selection ONLY: it picks WHICH pre-compiled
+    executable a batch runs (per-(bucket, eps) table, harvest-seeded,
+    force-pinnable), it never changes what any executable computes.
+    This check machine-verifies the enabled half of "routing disabled
+    == bit-identical": the solve/serve entry points are traced bare
+    (for BOTH backends — the routed programs), then a live router is
+    exercised for real — per-bucket decisions taken against a seeded
+    table, a winner seeded from a two-backend harvest aggregate, a
+    force() flip, a snapshot — and the entry points are re-traced.
+    The jaxprs must be string-identical, and the probe self-verifies
+    it actually routed (a table that seeded nothing, or decisions
+    that never consulted it, prove nothing).
+    """
+    import dataclasses
+
+    from porqua_tpu.qp.solve import SolverParams
+    from porqua_tpu.serve.bucketing import Bucket
+    from porqua_tpu.serve.routing import SolverRouter
+
+    params = SolverParams()
+
+    def trace_all():
+        out = []
+        for method in ("admm", "pdhg"):
+            p = dataclasses.replace(params, method=method)
+            out.append((f"solve_batch[{method}]",
+                        str(solve_batch_jaxpr(params=p, dtype=dtype))))
+            out.append((f"serve_entry[{method}]",
+                        str(serve_entry_jaxpr(params=p, dtype=dtype))))
+        return out
+
+    findings: List[Finding] = []
+    baseline = trace_all()
+
+    def probe_fail(msg: str) -> None:
+        findings.append(Finding(
+            "GC110", "<jaxpr:routing_identity>", 0, 0, msg))
+
+    # A live router, exercised end to end on the host: seed a route
+    # table from a two-backend aggregate (PDHG the clear winner at
+    # 16x4), take decisions on the seeded cell AND an unseeded one,
+    # flip the force pin both ways.
+    router = SolverRouter(params)
+    eps = float(params.eps_abs)
+    agg = {"groups": [{
+        "bucket": "16x4", "eps_abs": eps,
+        "by_solver": {
+            "admm": {"count": 8, "iters": {"p95": 900.0},
+                     "status_counts": {"1": 8}, "solve_s_mean": 4e-3},
+            "pdhg": {"count": 8, "iters": {"p95": 200.0},
+                     "status_counts": {"1": 8}, "solve_s_mean": 1e-3},
+        }}]}
+    seeded = router.seed_from_aggregate(agg)
+    routed = router.route(Bucket(16, 4))
+    default = router.route(Bucket(32, 8))
+    router.force("admm")
+    forced = router.route(Bucket(16, 4))
+    router.force(None)
+    unpinned = router.route(Bucket(16, 4))
+    snap = router.snapshot()
+    if seeded != {f"16x4@{eps:.0e}": "pdhg"} or routed != "pdhg" \
+            or default != "admm" or forced != "admm" \
+            or unpinned != "pdhg" or snap["decisions"]["pdhg"] != 2:
+        probe_fail("the routing probe did not seed and take the "
+                   "expected decisions — the identity check exercised "
+                   f"a broken router (seeded={seeded}, snap={snap})")
+
+    live = trace_all()
+    for (label, base), (_, lv) in zip(baseline, live):
+        if base != lv:
+            findings.append(Finding(
+                "GC110", f"<jaxpr:{label}>", 0, 0,
+                "traced program differs with a live SolverRouter "
+                "exercised: routing is no longer host-side dispatch "
+                "selection only (disabled-bit-identity contract "
+                "broken)"))
+    return findings
+
+
 def run_batch_jaxpr(bs, params=None, dtype=np.float32) -> ClosedJaxpr:
     """Trace ``run_batch``'s device core against a *real*
     ``BacktestService``: the host pass (``build_problems``) runs for
@@ -876,4 +960,34 @@ def check_entry_points(dtype=np.float32,
     # traced solve/serve programs string-identical (tenancy is
     # host-side scheduling + attribution only).
     findings += check_tenancy_identity(dtype=dtype)
+    # The PDHG backend's programs carry the same GC101-103 proofs as
+    # ADMM's — the routed executables are peers, not exceptions: the
+    # restarted segment stepper is sync-free, f64-free, and lands the
+    # same output dtypes through the shared finalize/compaction/
+    # continuous plumbing.
+    pdhg = SolverParams(method="pdhg")
+    findings += check_closed_jaxpr(
+        solve_batch_jaxpr(params=pdhg, dtype=dtype),
+        "solve_batch[pdhg]", expect_float=dtype)
+    findings += check_closed_jaxpr(
+        serve_entry_jaxpr(params=pdhg, dtype=dtype),
+        "serve_entry[pdhg]", expect_float=dtype)
+    if ring_size:
+        findings += check_closed_jaxpr(
+            solve_batch_jaxpr(
+                params=SolverParams(method="pdhg", ring_size=ring_size),
+                dtype=dtype),
+            "solve_batch[pdhg,rings]", expect_float=dtype)
+    findings += check_closed_jaxpr(
+        compaction_step_jaxpr(params=pdhg, dtype=dtype),
+        "compaction_step[pdhg]", expect_float=dtype)
+    for label, jaxpr in continuous_jaxprs(params=pdhg, dtype=dtype):
+        findings += check_closed_jaxpr(
+            jaxpr, f"{label}[pdhg]", expect_float=dtype)
+    # GC110: and for solver routing — a harvest-seeded route table
+    # consulted per bucket, a force() flip, a snapshot — all of it
+    # must leave both backends' traced solve/serve programs string-
+    # identical (routing picks which compiled program runs, it never
+    # touches a traced one).
+    findings += check_routing_identity(dtype=dtype)
     return findings

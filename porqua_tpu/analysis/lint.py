@@ -100,6 +100,7 @@ RULE_DOCS = {
     "GC107": "device-truth cost plane perturbs a traced program",
     "GC108": "fleet federation plane perturbs a traced program",
     "GC109": "tenant plane perturbs a traced program",
+    "GC110": "solver routing perturbs a traced program",
 }
 
 _CONTRACTIONS = {"dot", "einsum", "matmul", "tensordot", "inner", "vdot"}

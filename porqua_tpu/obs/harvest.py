@@ -95,8 +95,8 @@ DEFAULT_TENANT = "default"
 LEGACY_TENANT = "(pre-tenant)"
 
 #: Known values of a record's ``source`` field (producer provenance).
-SOURCES = ("serve", "serve.continuous", "batch", "batch.compacted",
-           "backtest.scan")
+SOURCES = ("serve", "serve.continuous", "serve.shadow", "batch",
+           "batch.compacted", "backtest.scan")
 
 
 def solve_record(source: str,
@@ -156,6 +156,13 @@ def solve_record(source: str,
         rec["eps_rel"] = float(params.eps_rel)
         rec["max_iter"] = int(params.max_iter)
         rec["check_interval"] = int(params.check_interval)
+        # Which first-order backend produced the lane ("admm" | "pdhg")
+        # — the routing tables train on this axis. Additive: records
+        # predating the field (or written without params) read back as
+        # "admm" everywhere (aggregate / harvest_report), which is what
+        # every pre-PDHG record actually ran. An explicit ``solver=``
+        # kwarg (e.g. shadow-compare records) overrides via ``extra``.
+        rec["solver"] = str(getattr(params, "method", "admm"))
         if segments is None:
             ci = int(params.check_interval)
             segments = max(-(-int(iters) // ci), 1)
@@ -474,6 +481,36 @@ def aggregate(records: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
         if warm_iters and cold_iters:
             row["warm_minus_cold_iters_mean"] = float(
                 np.mean(warm_iters) - np.mean(cold_iters))
+        if any("solver" in r for r in recs):
+            # The backend axis (records written since the PDHG PR carry
+            # it; solver-absent records — every pre-PDHG dataset — read
+            # back as "admm", which is what they ran). Per-backend
+            # iteration quantiles + mean dispatch latency: the
+            # comparison table harvest_report renders and the
+            # SolverRouter's seed (serve/routing.py) — a backend's
+            # entry is its evidence for winning this (tenant, bucket,
+            # eps) cell.
+            by_solver: Dict[str, Dict[str, Any]] = {}
+            for sv in sorted({str(r.get("solver", "admm"))
+                              for r in recs}):
+                srecs = [r for r in recs
+                         if str(r.get("solver", "admm")) == sv]
+                sstat: Dict[str, int] = {}
+                for r in srecs:
+                    s = str(r["status"])
+                    sstat[s] = sstat.get(s, 0) + 1
+                entry: Dict[str, Any] = {
+                    "count": len(srecs),
+                    "iters": _quantiles([float(r["iters"])
+                                         for r in srecs]),
+                    "status_counts": sstat,
+                }
+                lat = [float(r["solve_s"]) for r in srecs
+                       if r.get("solve_s") is not None]
+                if lat:
+                    entry["solve_s_mean"] = float(np.mean(lat))
+                by_solver[sv] = entry
+            row["by_solver"] = by_solver
         table.append(row)
     return {
         "schema_version": SCHEMA_VERSION,

@@ -74,6 +74,15 @@ class SolverParams:
 
     max_iter: int = 4000
     check_interval: int = 25
+    # First-order backend: "admm" (this module) or "pdhg" (restarted
+    # primal-dual hybrid gradient, qp/pdhg.py). Both implement the same
+    # segment-stepper contract (init / segment_step / shared finalize),
+    # run on the same Ruiz-equilibrated canonical form, and carry their
+    # state as an ADMMState — so compaction, continuous batching,
+    # serving, harvest, and the ring telemetry work unmodified for
+    # either. Part of the params hash, hence of every executable-cache
+    # identity: per-backend executables come for free.
+    method: str = "admm"
     # "auto" == "xla" everywhere: the fused Pallas kernel is opt-in
     # only (its explicit f32 inverse costs iterations — see the backend
     # selection note in admm_solve); "pallas" forces the fused segment.
@@ -181,6 +190,24 @@ class SolverParams:
     # promotes 10.0 (measured optimum at production scale — see the
     # segment body and BASELINE.md).
     rho_l1_scale: float = 1.0
+    # PDHG backend knobs (method="pdhg" only; inert otherwise so the
+    # ADMM executables' params identity is unchanged by their
+    # presence). Restart rule (PDLP-style, arXiv:2311.07710): at each
+    # residual check the normalized residual of the current iterate
+    # AND of the restart-window average candidate are measured; the
+    # solver restarts — adopting the better of the two and resetting
+    # the averaging window — on sufficient decay
+    # (pdhg_restart_decrease * the residual at the last restart) or
+    # forcibly after pdhg_restart_max_windows checks without one.
+    # pdhg_omega0 is the initial primal weight omega (tau =
+    # 1/(L_P + omega*||C||), sigma = omega/||C||); adaptive_rho
+    # rebalances it at restarts exactly like ADMM's rho.
+    pdhg_restart_decrease: float = 0.25
+    pdhg_restart_max_windows: int = 8
+    pdhg_omega0: float = 1.0
+    # Power-iteration count for the ||P||/||C|| spectral estimates
+    # computed once at pdhg_init (they set the step sizes).
+    pdhg_power_iters: int = 20
     scaling_iters: int = 10
     # "ruiz": modified Ruiz sweeps over the dense P (scaling_iters of
     # them). "factored": Jacobi scaling computed from the objective

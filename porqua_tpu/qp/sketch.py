@@ -1,0 +1,200 @@
+"""Seeded subspace embedding for the tracking-QP Gram build.
+
+For a universe of N assets and a (T, N) return window X, the dense
+objective assembly ``P = 2 X'X`` costs O(T N^2) — at N = 5,000 the
+Gram build dominates the whole rebalance step. A count-sketch
+(Clarkson-Woodruff sparse embedding) ``S`` of the *row* (date) space —
+each date hashed to one of ``sketch_dim`` buckets with a random sign —
+compresses the window to ``Xs = S X`` of shape (sketch_dim, N) in one
+O(T N) pass (a signed segment-sum, MXU-free), after which every
+downstream consumer is cheaper by T/sketch_dim: the Gram build, the
+``Pf`` factor rows the Woodbury dual-space linsolve carries, and the
+PDHG backend's per-iteration ``apply_P``.
+
+Because S is applied to the stacked ``[X | y]`` window, the sketched
+problem is the least-squares objective ``||S(Xw - y)||^2`` — a
+subspace embedding of the true residual, so the minimizer is near the
+true one with the usual (1 +- eps) Gram guarantee. The error is not
+assumed, it is *measured*: :func:`gram_rel_err` probes
+``||X'Xv - Xs'Xs v|| / ||X'Xv||`` with seeded random vectors and the
+bound rides the result (``SketchInfo.gram_rel_err``), so promotion
+gates can reject a sketch that is too lossy for a given universe.
+
+Disabled (``sketch_dim == 0``, the default — or a sketch_dim that
+would not compress) the pipeline is a bit-exact passthrough: the same
+``build_tracking_qp`` call on the untouched window, pinned by the
+bench ``config_sketch`` A/B and ``bench_gate``'s
+``sketch_off_te_drift <= 1e-6`` rule.
+
+Everything is jittable with ``SketchParams`` static (it is frozen and
+hashable, same convention as ``SolverParams``); the sketch itself is
+seeded and deterministic — same (seed, shapes) => same embedding, so
+reruns and multi-host replays reconcile.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from porqua_tpu.qp.canonical import HP
+from porqua_tpu.tracking import TrackingResult, build_tracking_qp
+
+__all__ = [
+    "SketchParams",
+    "SketchInfo",
+    "count_sketch",
+    "gram_rel_err",
+    "sketched_tracking_qp",
+    "tracking_step_sketched",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class SketchParams:
+    """Static sketch configuration (hashable, jit-static — part of an
+    executable's identity exactly like ``SolverParams``).
+
+    sketch_dim: embedding rows. 0 disables the sketch entirely
+        (bit-exact passthrough). A value >= the window length T also
+        passes through — a "sketch" that does not compress must not
+        perturb the problem.
+    seed: the embedding's PRNG seed (bucket hashes + signs + the
+        error-probe vectors all derive from it).
+    probes: random probe vectors for the measured Gram error bound.
+    """
+
+    sketch_dim: int = 0
+    seed: int = 0
+    probes: int = 8
+
+
+class SketchInfo(NamedTuple):
+    """What the sketch did, surfaced on the solution path: ``sketch_dim``
+    is the *effective* dim (0 when passthrough — disabled or
+    non-compressing), ``gram_rel_err`` the measured probe bound (exact
+    0 on the passthrough path)."""
+
+    sketch_dim: jax.Array    # () int32, effective embedding rows
+    rows_in: jax.Array       # () int32, window length T
+    gram_rel_err: jax.Array  # () max_k ||(G - Gs) v_k|| / ||G v_k||
+
+
+def count_sketch(M: jax.Array, sketch_dim: int, key: jax.Array) -> jax.Array:
+    """Apply a Clarkson-Woodruff count-sketch to the leading (row) axis:
+    ``(T, k) -> (sketch_dim, k)``. Each row lands in one signed bucket,
+    so the whole embedding is a single ``segment_sum`` — O(T k), no
+    matmul, and trivially fused by XLA into the surrounding assembly."""
+    T = M.shape[0]
+    kb, ks = jax.random.split(key)
+    bucket = jax.random.randint(kb, (T,), 0, sketch_dim)
+    sign = jax.random.rademacher(ks, (T,), M.dtype)
+    return jax.ops.segment_sum(sign[:, None] * M, bucket,
+                               num_segments=sketch_dim)
+
+
+def gram_rel_err(X: jax.Array, Xs: jax.Array, key: jax.Array,
+                 probes: int) -> jax.Array:
+    """Measured Gram-error bound: ``max_k ||X'(Xv_k) - Xs'(Xs v_k)|| /
+    ||X'(Xv_k)||`` over seeded Gaussian probes — four tall-skinny
+    matvecs per probe, never the O(N^2) Grams themselves, so the bound
+    stays cheap at the universe sizes the sketch exists for."""
+    n = X.shape[-1]
+    V = jax.random.normal(key, (probes, n), X.dtype)
+
+    def one(v):
+        gv = jnp.dot(jnp.dot(X, v, precision=HP), X, precision=HP)
+        gsv = jnp.dot(jnp.dot(Xs, v, precision=HP), Xs, precision=HP)
+        return (jnp.linalg.norm(gv - gsv)
+                / jnp.maximum(jnp.linalg.norm(gv), 1e-12))
+
+    return jnp.max(jax.vmap(one)(V))
+
+
+def _effective_dim(sketch: SketchParams, T: int) -> int:
+    """The dim actually applied: 0 (passthrough) unless the sketch both
+    is enabled and compresses."""
+    d = sketch.sketch_dim
+    return d if 0 < d < T else 0
+
+
+def sketched_tracking_qp(X: jax.Array,
+                         y: jax.Array,
+                         sketch: SketchParams = SketchParams(),
+                         ridge: float = 0.0,
+                         lb: float = 0.0,
+                         ub: float = 1.0):
+    """Lower one (T, N) window to the tracking QP through the (optional)
+    embedding; returns ``(CanonicalQP, SketchInfo)``.
+
+    The sketch is applied to the stacked ``[X | y]`` window so the
+    sketched problem is exactly ``min ||S(Xw - y)||^2`` over the same
+    polytope — then handed to the *same*
+    :func:`porqua_tpu.tracking.build_tracking_qp`, which is what makes
+    the disabled path bit-exact: passthrough is literally the identical
+    call on the untouched arrays (and ``jax.eval_shape``-visible: the
+    sketched QP carries ``Pf`` with ``sketch_dim`` rows, a distinct
+    serving bucket).
+    """
+    T = X.shape[0]
+    d = _effective_dim(sketch, T)
+    if d == 0:
+        qp = build_tracking_qp(X, y, ridge=ridge, lb=lb, ub=ub)
+        info = SketchInfo(
+            sketch_dim=jnp.asarray(0, jnp.int32),
+            rows_in=jnp.asarray(T, jnp.int32),
+            gram_rel_err=jnp.asarray(0.0, X.dtype),
+        )
+        return qp, info
+
+    key = jax.random.key(sketch.seed)
+    k_embed, k_probe = jax.random.split(key)
+    stacked = jnp.concatenate([X, y[:, None]], axis=1)
+    sk = count_sketch(stacked, d, k_embed)
+    Xs, ys = sk[:, :-1], sk[:, -1]
+    qp = build_tracking_qp(Xs, ys, ridge=ridge, lb=lb, ub=ub)
+    info = SketchInfo(
+        sketch_dim=jnp.asarray(d, jnp.int32),
+        rows_in=jnp.asarray(T, jnp.int32),
+        gram_rel_err=gram_rel_err(X, Xs, k_probe, sketch.probes),
+    )
+    return qp, info
+
+
+def tracking_step_sketched(Xs: jax.Array,
+                           ys: jax.Array,
+                           params=None,
+                           sketch: SketchParams = SketchParams(),
+                           ridge: float = 0.0
+                           ) -> Tuple[TrackingResult, SketchInfo]:
+    """The sketched twin of :func:`porqua_tpu.tracking.tracking_step`:
+    build (through the embedding) + solve + evaluate for a batch of
+    date windows, one XLA program. The tracking error is ALWAYS
+    measured against the true (unsketched) window — the sketch may
+    only approximate the problem, never the evaluation — so the bench
+    A/B's TE drift is a real quality delta, not a self-graded one.
+    Jittable with ``(params, sketch, ridge)`` static."""
+    from porqua_tpu.qp.solve import SolverParams, _solve_impl
+
+    if params is None:
+        params = SolverParams()
+
+    def one(X, y):
+        qp, info = sketched_tracking_qp(X, y, sketch, ridge=ridge)
+        sol = _solve_impl(qp, params, None, None)
+        resid = jnp.dot(X, sol.x, precision=HP) - y
+        te = jnp.sqrt(jnp.mean(resid * resid))
+        return sol, te, info
+
+    sols, tes, infos = jax.vmap(one)(Xs, ys)
+    return TrackingResult(
+        weights=sols.x,
+        tracking_error=tes,
+        status=sols.status,
+        iters=sols.iters,
+        prim_res=sols.prim_res,
+        dual_res=sols.dual_res,
+    ), infos

@@ -30,8 +30,26 @@ from porqua_tpu.qp.admm import (
     _support,
 )
 from porqua_tpu.qp.canonical import CanonicalQP, HP
+from porqua_tpu.qp.pdhg import pdhg_init, pdhg_segment_step, pdhg_solve
 from porqua_tpu.qp.polish import polish_iterate as _polish_iterate
 from porqua_tpu.qp.ruiz import Scaling, equilibrate, equilibrate_factored
+
+
+def _backend(params: SolverParams):
+    """Resolve ``params.method`` to the ``(init, segment_step, solve)``
+    triple of the selected first-order backend. Both backends carry
+    their iterate as an ``ADMMState`` and share :func:`_prepare_impl` /
+    :func:`_finalize_impl`, so this is the ONLY dispatch point — every
+    driver above (fused solve, compaction, continuous serving) is
+    backend-agnostic. A typo'd method silently running the wrong solver
+    would poison routing tables and promotion evidence — fail loudly
+    (same idiom as ``scaling_mode``)."""
+    if params.method == "admm":
+        return admm_init, admm_segment_step, admm_solve
+    if params.method == "pdhg":
+        return pdhg_init, pdhg_segment_step, pdhg_solve
+    raise ValueError(
+        f"unknown method {params.method!r}; expected 'admm' or 'pdhg'")
 
 
 class QPSolution(NamedTuple):
@@ -206,8 +224,9 @@ def _solve_impl(qp: CanonicalQP,
                 l1_center: Optional[jax.Array] = None) -> QPSolution:
     scaled, scaling, x0_s, y0_s, l1w_s, l1c_s = _prepare_impl(
         qp, params, x0, y0, l1_weight, l1_center)
-    state = admm_solve(scaled, scaling, params, x0=x0_s, y0=y0_s,
-                       l1_weight=l1w_s, l1_center=l1c_s)
+    _, _, solver = _backend(params)
+    state = solver(scaled, scaling, params, x0=x0_s, y0=y0_s,
+                   l1_weight=l1w_s, l1_center=l1c_s)
     return _finalize_impl(qp, scaled, scaling, state, params,
                           l1_weight, l1_center, l1w_s, l1c_s)
 
@@ -296,11 +315,12 @@ def prepare_batch(qp: CanonicalQP,
     """
     in_axes = tuple(None if a is None else 0
                     for a in (qp, x0, y0, l1_weight, l1_center))
+    init, _, _ = _backend(params)
 
     def one(q, xx, yy, lw, lc):
         scaled, scaling, x0_s, y0_s, l1w_s, l1c_s = _prepare_impl(
             q, params, xx, yy, lw, lc)
-        carry = admm_init(scaled, params, x0_s, y0_s)
+        carry = init(scaled, params, x0_s, y0_s)
         return scaled, scaling, carry, l1w_s, l1c_s
 
     return jax.vmap(one, in_axes=(0,) + in_axes[1:])(
@@ -313,15 +333,19 @@ def segment_step_batch(scaled: CanonicalQP,
                        params: SolverParams,
                        l1w_s: Optional[jax.Array] = None,
                        l1c_s: Optional[jax.Array] = None) -> ADMMCarry:
-    """Advance every lane one residual-check segment (vmapped
-    :func:`porqua_tpu.qp.admm.admm_segment_step`). Per-lane status
-    lives in ``carry.state.status``."""
+    """Advance every lane one residual-check segment (the vmapped
+    segment stepper of the backend ``params.method`` selects —
+    :func:`porqua_tpu.qp.admm.admm_segment_step` or
+    :func:`porqua_tpu.qp.pdhg.pdhg_segment_step`; the carry is the
+    matching backend's, always with ``.state: ADMMState``). Per-lane
+    status lives in ``carry.state.status``."""
     in_axes = (0, 0, 0,
                None if l1w_s is None else 0,
                None if l1c_s is None else 0)
+    _, seg_step, _ = _backend(params)
 
     def one(c, s, sc, lw, lc):
-        return admm_segment_step(c, s, sc, params, lw, lc)[0]
+        return seg_step(c, s, sc, params, lw, lc)[0]
 
     return jax.vmap(one, in_axes=in_axes)(carry, scaled, scaling,
                                           l1w_s, l1c_s)

@@ -54,6 +54,7 @@ from porqua_tpu.serve.bucketing import (
     slot_ladder,
 )
 from porqua_tpu.serve.metrics import ServeMetrics
+from porqua_tpu.serve.routing import SolverRouter
 from porqua_tpu.serve.service import (
     DeviceHealth,
     QueueFull,
@@ -83,6 +84,7 @@ __all__ = [
     "SolveError",
     "SolveResult",
     "SolveService",
+    "SolverRouter",
     "Ticket",
     "WarmStartCache",
     "problem_fingerprint",

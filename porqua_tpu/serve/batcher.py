@@ -194,8 +194,15 @@ class MicroBatcher:
                  anomaly=None,
                  admission=None,
                  tenant_weights=None,
-                 tenant_slos=None) -> None:
+                 tenant_slos=None,
+                 router=None) -> None:
         self.cache = cache
+        # Optional porqua_tpu.serve.routing.SolverRouter: per-(bucket,
+        # eps) backend choice at dispatch time, resolved host-side to
+        # one of the router's per-method executable caches. None =
+        # every dispatch runs self.cache (the service's own params) —
+        # the pre-routing behavior, bit for bit.
+        self.router = router
         self.health = health
         self.metrics = metrics
         # Tenancy (porqua_tpu.serve.tenancy): the shared admission
@@ -388,8 +395,23 @@ class MicroBatcher:
                     m.inc("warm_hits")
                     m.inc_tenant(r.tenant or DEFAULT_TENANT, "warm_hits")
 
+        # Solver routing: one backend decision per dispatch (every
+        # lane of a fused batch necessarily runs the same program).
+        # Pure host-side — the routed cache's executables were
+        # compiled ahead of time by SolverRouter.prewarm, so a table
+        # flip here is a different cache lookup, never a retrace.
+        if self.router is not None:
+            method, cache = self.router.decide(bucket)
+        else:
+            cache = self.cache
+            method = cache.params.method
+        m.inc(f"routed_{method}", len(live))
+        for r in live:
+            m.inc_tenant(r.tenant or DEFAULT_TENANT, f"routed_{method}")
+
         t_exec0 = time.monotonic()
-        out = self._execute(bucket, slots, dtype, qp, x0, y0, live)
+        out = self._execute(bucket, slots, dtype, qp, x0, y0, live,
+                            cache=cache)
         if out is None:
             return
         sol, device_label, solve_s, device_kind = out
@@ -421,12 +443,12 @@ class MicroBatcher:
             # as the drift probe (qp_solve_profile cost= docs).
             fr = (None if getattr(qp, "Pf", None) is None
                   else int(np.shape(qp.Pf)[-2]))
-            cost = self.cache.cost_record_for(
+            cost = cache.cost_record_for(
                 bucket, slots, dtype, kind="solve",
                 device_label=device_label)
             profile = _profile.qp_solve_profile(
                 bucket.n, bucket.m, float(iters[:len(live)].mean()),
-                solve_s, params=self.cache.params, batch=slots,
+                solve_s, params=cache.params, batch=slots,
                 factor_rows=fr, device_kind=device_kind, cost=cost)
         done = time.monotonic()
         # The fused batch steps EVERY lane until the slowest converges
@@ -435,7 +457,7 @@ class MicroBatcher:
         # per-lane waste (1 - iters/(executed*ci)) must divide by —
         # each lane's own ceil(iters/ci) would read ~zero waste for
         # every lane and blind the detector to straggler drift.
-        ci = max(int(self.cache.params.check_interval), 1)
+        ci = max(int(cache.params.check_interval), 1)
         exec_segs = max(-(-int(iters[:len(live)].max()) // ci), 1)
         for i, r in enumerate(live):
             # Spans are recorded BEFORE the future resolves: a caller
@@ -456,9 +478,18 @@ class MicroBatcher:
                                  prim, dual, obj, rp, rd, rr, done,
                                  device_label, warm[i],
                                  solve_s=solve_s, profile=profile,
-                                 executed_segments=exec_segs)
+                                 executed_segments=exec_segs,
+                                 params=cache.params)
         m.observe_batch(len(live), slots, solve_s,
                         float(iters[:len(live)].mean()))
+        # Shadow-compare AFTER every future resolved: the sampled
+        # alternate-backend solve feeds the routing tables' evidence
+        # without ever sitting on a request's critical path.
+        if self.router is not None:
+            self.router.maybe_shadow(
+                bucket, slots, dtype, self.health.device(), qp, x0, y0,
+                method, {"status": status, "iters": iters, "obj": obj},
+                live, self.harvest)
         self._plane_tick()
 
     def _plane_tick(self) -> None:
@@ -487,7 +518,8 @@ class MicroBatcher:
                         segments: Optional[int] = None,
                         solve_s: Optional[float] = None,
                         profile: Optional[dict] = None,
-                        executed_segments: Optional[int] = None) -> None:
+                        executed_segments: Optional[int] = None,
+                        params=None) -> None:
         """Shared per-request retirement: warm-start cache put, the
         latency / completed / per-lane-Status metrics, the harvest
         record, and future resolution with the trimmed, copied
@@ -523,7 +555,12 @@ class MicroBatcher:
         # a converged one.
         m.observe_status(int(status[i]))
         m.observe_request_iters(int(iters[i]))
-        params = self.cache.params
+        if params is None:
+            # The params the lane actually solved under — a routed
+            # dispatch passes the routed cache's (its harvest record
+            # must carry the backend that produced it, not the
+            # service default).
+            params = self.cache.params
         if self.harvest is not None or self.flight is not None:
             ring = None
             if rp is not None:
@@ -585,11 +622,15 @@ class MicroBatcher:
                 tenant=tenant)
 
     def _execute(self, bucket: Bucket, slots: int, dtype, qp, x0, y0,
-                 live: List[SolveRequest]):
+                 live: List[SolveRequest], cache=None):
         """Run the batch on the current device; on failure, let the
         health manager trip the breaker and retry once on whatever
         device it now points at (the degrade path: TPU -> XLA-CPU
-        instead of erroring the requests)."""
+        instead of erroring the requests). ``cache`` is the executable
+        cache to dispatch through — the router-chosen backend's when
+        solver routing is live, ``self.cache`` otherwise."""
+        if cache is None:
+            cache = self.cache
         last_exc: Optional[Exception] = None
         for _attempt in range(4):  # bounded: threshold trips inside this
             device = self.health.device()
@@ -604,7 +645,7 @@ class MicroBatcher:
                         bucket=f"{bucket.n}x{bucket.m}",
                         device=(f"{device.platform}:{device.id}"
                                 if device is not None else "default"))
-                exe = self.cache.get(bucket, slots, dtype, device)
+                exe = cache.get(bucket, slots, dtype, device)
                 with _profile.profiled_stage(
                         self.profiler, "serve/solve_batch",
                         "solve_batch") as prof:

@@ -135,6 +135,11 @@ class _Cohort:
         # cohort's device shape is fixed at creation — growth happens
         # by replacement, never by resize).
         self.no_refill = False
+        # Which backend's compiled triple this cohort runs and its
+        # SolverParams — the batcher stamps both at creation (routing
+        # metadata; the exes themselves already embody the choice).
+        self.method: str = "admm"
+        self.params = None
 
     def write_slot(self, slot: int, qp: CanonicalQP) -> None:
         """Overwrite one slot's rows of the stacked problem buffer
@@ -253,9 +258,22 @@ class ContinuousBatcher(MicroBatcher):
             dtype = np.dtype(np.asarray(dq[0].qp.q).dtype)
             slots = slot_count(min(len(dq), self.max_batch),
                                self.max_batch)
-            exes = self.cache.get_continuous(bucket, slots, dtype, device)
-            self._cohorts[bucket] = _Cohort(bucket, slots, dtype,
-                                            device, exes)
+            # Solver routing binds at cohort creation: a cohort's
+            # compiled triple IS one backend's program, so every lane
+            # admitted over its lifetime runs that backend. A route
+            # flip takes effect at the next cohort (replacement or
+            # fresh bucket) — never by retracing a live one.
+            if self.router is not None:
+                method, cache = self.router.decide(bucket)
+                params = cache.params
+            else:
+                cache, params = self.cache, self.params
+                method = params.method
+            exes = cache.get_continuous(bucket, slots, dtype, device)
+            cohort = _Cohort(bucket, slots, dtype, device, exes)
+            cohort.method = method
+            cohort.params = params
+            self._cohorts[bucket] = cohort
         except sanitize.SanitizerError as exc:
             # A policy violation (e.g. a refused post-warmup compile)
             # is not a device fault: fail these requests loudly and
@@ -320,6 +338,12 @@ class ContinuousBatcher(MicroBatcher):
                     cohort.warm[slot] = True
                     m.inc("warm_hits")
                     m.inc_tenant(r.tenant or DEFAULT_TENANT, "warm_hits")
+            # The routing decision this lane rides (bound at cohort
+            # creation): counted at admission, the continuous-mode
+            # analogue of the classic path's per-dispatch bump.
+            m.inc(f"routed_{cohort.method}")
+            m.inc_tenant(r.tenant or DEFAULT_TENANT,
+                         f"routed_{cohort.method}")
             cohort.staged.append(slot)
 
     def _tick_safe(self, bucket: Bucket, cohort: _Cohort) -> None:
@@ -515,7 +539,8 @@ class ContinuousBatcher(MicroBatcher):
             self._finish_request(r, bucket, j, xs, ys, fstat, fit,
                                  prim, dual, obj, rp, rd, rr, done,
                                  device_label, cohort.warm[i],
-                                 segments=int(cohort.seg_count[i]))
+                                 segments=int(cohort.seg_count[i]),
+                                 params=cohort.params)
             cohort.reqs[i] = None
             cohort.write_slot(i, cohort.neutral)
             cohort.active[i] = False

@@ -83,6 +83,46 @@ def build_tracking_requests(n_requests: int,
     return out
 
 
+def build_exposure_requests(n_requests: int,
+                            n_assets: int = 96,
+                            n_rows: int = 16,
+                            seed: int = 7,
+                            box: float = 0.3) -> List[CanonicalQP]:
+    """Risk-model mean-variance QPs with factor-exposure *bands*: a
+    dense factor-model covariance objective, budget row, long-only box
+    with a position cap, and ``n_rows - 1`` general inequality rows
+    holding random factor exposures inside ±1. The second production
+    family next to :func:`build_tracking_requests` — and a different
+    solver regime: the general rows put real work into the dual, where
+    the restarted PDHG backend (no inner factorization, restart-adapted
+    step sizes) typically clears the problem in a fraction of ADMM's
+    iterations. That contrast per (bucket, eps) cell is exactly what
+    the harvest-seeded :class:`porqua_tpu.serve.routing.SolverRouter`
+    exists to exploit."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n_requests):
+        F = rng.standard_normal((max(2, n_assets // 4), n_assets))
+        P = (F.T @ F / n_assets
+             + 0.1 * np.eye(n_assets)).astype(np.float32)
+        q = rng.standard_normal(n_assets).astype(np.float32)
+        C = np.vstack([
+            np.ones((1, n_assets), np.float32),
+            rng.standard_normal((n_rows - 1, n_assets)).astype(np.float32),
+        ])
+        lo = np.concatenate([[1.0], -np.ones(n_rows - 1)]).astype(np.float32)
+        hi = np.concatenate([[1.0], np.ones(n_rows - 1)]).astype(np.float32)
+        out.append(CanonicalQP(
+            P=P, q=q, C=C, l=lo, u=hi,
+            lb=np.zeros(n_assets, np.float32),
+            ub=np.full(n_assets, box, np.float32),
+            var_mask=np.ones(n_assets, np.float32),
+            row_mask=np.ones(n_rows, np.float32),
+            constant=np.float32(0.0),
+        ))
+    return out
+
+
 def prewarm_buckets(service: SolveService, requests) -> tuple:
     """Prewarm every DISTINCT bucket ``requests`` touches (a
     mixed-tenant blend carries tracking + LAD + turnover shapes — a

@@ -65,6 +65,10 @@ COUNTERS = (
     "retry_giveups",        # requests abandoned (attempts or deadline)
     "validation_failures",  # results withheld as non-finite
     "faults_injected",      # chaos: faults the injector fired
+    # Solver routing (porqua_tpu.serve.routing):
+    "routed_admm",          # live requests dispatched on the ADMM backend
+    "routed_pdhg",          # live requests dispatched on the PDHG backend
+    "shadow_solves",        # shadow-compare batches run on the alternate
 )
 
 #: Per-tenant counter names (the tenant axis of the snapshot /
@@ -82,6 +86,8 @@ TENANT_COUNTERS = (
     "retry_giveups",      # recovery layer abandoned the request
     "validation_failures",  # withheld non-finite answers
     "warm_hits",          # warm-start cache hits
+    "routed_admm",        # this tenant's requests served by ADMM
+    "routed_pdhg",        # this tenant's requests served by PDHG
 )
 
 #: Status code -> counter suffix (mirrors porqua_tpu.qp.admm.Status —

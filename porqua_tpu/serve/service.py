@@ -290,6 +290,7 @@ class SolveService:
                  tenant_quota=None,
                  tenant_weights=None,
                  tenant_slos=None,
+                 router=None,
                  **health_kwargs) -> None:
         self.params = params
         self.continuous = bool(continuous)
@@ -360,6 +361,38 @@ class SolveService:
             # An externally-built health manager still reports through
             # this service's bus unless it already has its own.
             self.health.events = events
+        # Optional porqua_tpu.serve.routing.SolverRouter: per-(bucket,
+        # eps) backend choice over per-method executable caches. The
+        # service adopts the router's cache for ITS OWN method as
+        # self.cache (so every router-less code path — cost records,
+        # param reads, default dispatch — sees the params it was
+        # configured with), and the batcher consults the router per
+        # dispatch/cohort.
+        self.router = router
+        if router is not None:
+            if cache is not None:
+                raise ValueError(
+                    "pass either router= or cache=, not both (the "
+                    "router owns its per-backend caches)")
+            if router.params_for(params.method) != params:
+                # Same guard as the shared-cache path: a shared router
+                # must solve at this service's configuration.
+                raise ValueError(
+                    "shared SolverRouter was built for different "
+                    "SolverParams than this service's")
+            # A router built before the service may have no telemetry
+            # wired; adopt this service's so routed compiles/events
+            # land in the same place a router-less service's would.
+            if router.metrics is None:
+                router.metrics = self.metrics
+            if router.events is None:
+                router.events = events
+            for c in router.caches.values():
+                if c.metrics is None:
+                    c.metrics = self.metrics
+                if c.events is None:
+                    c.events = events
+            cache = router.caches[params.method]
         if cache is None:
             # cost_log threads through to the device-truth cost
             # warehouse (porqua_tpu.obs.devprof): None = in-memory
@@ -400,7 +433,7 @@ class SolveService:
             obs=obs, harvest=harvest, profiler=profiler,
             slo=slo, flight=flight, anomaly=anomaly,
             admission=self.admission, tenant_weights=tenant_weights,
-            tenant_slos=tenant_slos)
+            tenant_slos=tenant_slos, router=router)
         if self.continuous:
             # Continuous batching: cohorts step one segment at a time,
             # retire lanes the boundary they converge (or hit the
@@ -614,14 +647,20 @@ class SolveService:
         # A continuous service compiles ONLY the continuous triple —
         # the one-shot solve executables are unreachable from a
         # ContinuousBatcher and would double prewarm time for nothing.
-        n = self.cache.prewarm(bucket, self.batcher.max_batch, dtype,
-                               current, continuous=self.continuous,
-                               include_solve=not self.continuous)
+        # With solver routing live, prewarm goes through the router so
+        # BOTH backends' ladders compile — any later routing decision
+        # (table reseed, force(), a chaos flap) must dispatch into an
+        # already-compiled executable.
+        warm = self.cache.prewarm if self.router is None \
+            else self.router.prewarm
+        n = warm(bucket, self.batcher.max_batch, dtype,
+                 current, continuous=self.continuous,
+                 include_solve=not self.continuous)
         if self.health.fallback is not current:
-            n += self.cache.prewarm(bucket, self.batcher.max_batch,
-                                    dtype, self.health.fallback,
-                                    continuous=self.continuous,
-                                    include_solve=not self.continuous)
+            n += warm(bucket, self.batcher.max_batch,
+                      dtype, self.health.fallback,
+                      continuous=self.continuous,
+                      include_solve=not self.continuous)
         # Asymmetry, on purpose: when the breaker is ALREADY open at
         # prewarm time, only the fallback ladder compiles — AOT
         # compilation against a black-holed primary would hang prewarm

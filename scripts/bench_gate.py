@@ -127,6 +127,30 @@ RULES = [
     ("serving_bytes_drift",
      "config_serving.cost_summary.bytes_accessed_max",
      "rel_band", 0.10, "cost"),
+    # -- solver backends / routing / sketch -----------------------------
+    # Baseline-independent bars (le / eq): enforced whenever the
+    # candidate carries the part, skipped against artifacts that
+    # predate it. pdhg_te_band: the PDHG backend's iterate on the
+    # headline batch must sit within the same 2% quality band the
+    # tracking_error rule grants the ADMM one — a second backend that
+    # converges to a different answer is a solver bug, not a routing
+    # option. sketch_off_identity: the subspace-embedding path with
+    # the sketch DISABLED must be the bit-exact production program
+    # (same bar as compaction_te_parity). routing_*: the routed
+    # serving phase recompiles nothing after prewarm (both backends'
+    # ladders are compiled up front), reconciles its harvest exactly
+    # (one backend-tagged record per completed request), and serves
+    # zero unsolved requests while flipping backends per bucket.
+    ("pdhg_te_band", "config_pdhg.pdhg_te_rel_drift",
+     "le", 0.02, "quality"),
+    ("sketch_off_identity", "config_sketch.sketch_off_te_drift",
+     "le", 1e-6, "invariant"),
+    ("routing_recompiles", "config_routing.recompiles_after_warmup",
+     "eq", 0, "invariant"),
+    ("routing_reconciliation", "config_routing.harvest_reconciled",
+     "eq", 1, "invariant"),
+    ("routing_unsolved", "config_routing.unsolved",
+     "eq", 0, "invariant"),
     # -- tenancy: fairness / isolation invariants ----------------------
     # Multi-tenant artifacts (TENANT_rNN.json — serve_loadgen
     # --tenants reports) carry a tenant_fairness block; these are
@@ -383,9 +407,14 @@ def _selftest() -> int:
     v_good = check_payload(base, good)
     assert v_good["ok"], f"selftest: clean payload failed: {v_good['failed']}"
     # The only skips on a full single-tenant payload are the fairness
-    # rules (they apply to multi-tenant TENANT_rNN artifacts).
-    assert all(c["class"] == "fairness" for c in v_good["checks"]
-               if c["status"] == "skip"), v_good
+    # rules (multi-tenant TENANT_rNN artifacts) and the
+    # backend/routing/sketch bars (parts this synthetic payload does
+    # not carry — exercised in their own cell below).
+    _part_rules = {"pdhg_te_band", "sketch_off_identity",
+                   "routing_recompiles", "routing_reconciliation",
+                   "routing_unsolved"}
+    assert all(c["class"] == "fairness" or c["name"] in _part_rules
+               for c in v_good["checks"] if c["status"] == "skip"), v_good
 
     # A synthetically regressed payload: speedup and throughput
     # halved, a steady-state recompile, bit-parity broken, XLA cost
@@ -455,6 +484,35 @@ def _selftest() -> int:
     assert all(c["status"] == "skip" for c in
                check_payload(base, good)["checks"]
                if c["class"] == "fairness")
+
+    # Solver-backend / routing / sketch cells: baseline-independent
+    # bars. A payload carrying clean parts passes them; a PDHG
+    # backend outside the TE band, a sketch-off path that is not
+    # bit-exact, a routed phase that recompiled / lost harvest
+    # records / served an unsolved request each fail their own rule.
+    # Payloads without the parts (every pre-r12 artifact) skip them —
+    # asserted on v_good above via the fairness-only-skips check
+    # updated here.
+    routed_good = json.loads(json.dumps(base))
+    routed_good["config_pdhg"] = {"pdhg_te_rel_drift": 4.3e-4}
+    routed_good["config_sketch"] = {"sketch_off_te_drift": 0.0}
+    routed_good["config_routing"] = {"recompiles_after_warmup": 0,
+                                     "harvest_reconciled": 1,
+                                     "unsolved": 0}
+    v_routed = check_payload(base, routed_good)
+    assert v_routed["ok"], v_routed["failed"]
+    routed_bad = json.loads(json.dumps(routed_good))
+    routed_bad["config_pdhg"]["pdhg_te_rel_drift"] = 0.05
+    routed_bad["config_sketch"]["sketch_off_te_drift"] = 1e-3
+    routed_bad["config_routing"] = {"recompiles_after_warmup": 3,
+                                    "harvest_reconciled": 0,
+                                    "unsolved": 2}
+    v_routed_bad = check_payload(base, routed_bad)
+    assert not v_routed_bad["ok"]
+    for name in ("pdhg_te_band", "sketch_off_identity",
+                 "routing_recompiles", "routing_reconciliation",
+                 "routing_unsolved"):
+        assert name in v_routed_bad["failed"], v_routed_bad["failed"]
 
     # Trend cells: the SAME rule table gating against the rolling
     # median of a synthetic ledger. A candidate hovering at the

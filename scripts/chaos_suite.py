@@ -127,6 +127,17 @@ MODES = ("classic", "continuous")
 #: bundle carries the offending tenant id.
 TENANT_CELLS = ("noisy_neighbor", "tenant_feed_corrupt")
 
+#: Solver-routing cell (run_route_flap_cell below; classic AND
+#: continuous): a live SolverRouter force-flipped between the ADMM and
+#: PDHG backends mid-stream under load. Not a fault scenario — no
+#: injector — but the same unforgivable-outcome bar: every result
+#: must match the offline oracle whichever backend served it, both
+#: backends must actually serve traffic, nothing may fail, and the
+#: flapping must compile NOTHING after prewarm (both backends' ladders
+#: are prewarmed up front — a flap that recompiles would be a latency
+#: fault in production).
+ROUTE_CELLS = ("solver_route_flap",)
+
 #: The CI smoke (`--selftest`): one raising seam, one corruption seam
 #: riding the validation gate, and one continuous-mode run.
 SELFTEST = (("device_lost", "classic"), ("nan_lanes", "classic"),
@@ -439,6 +450,121 @@ def run_scenario(name, mode, seed, qps, refs, params, ladder, cache,
         shutil.rmtree(flight_dir, ignore_errors=True)
 
 
+def run_route_flap_cell(mode, seed, qps, refs, params, ladder,
+                        verbose=False):
+    """The ``solver_route_flap`` cell: serve rounds of oracle-checked
+    requests while force-flipping the router between backends — at
+    round boundaries AND halfway through a round's submissions, so
+    dispatches straddle the flip. The final rounds unpin (``force
+    (None)``) to prove the service returns to table/default routing
+    clean."""
+    import jax
+
+    from porqua_tpu.obs import Observability
+    from porqua_tpu.serve.metrics import ServeMetrics
+    from porqua_tpu.serve.routing import SolverRouter
+    from porqua_tpu.serve.service import DeviceHealth, SolveService
+
+    metrics = ServeMetrics()
+    obs = Observability()
+    devices = jax.devices()
+    primary, fallback = devices[-1], devices[0]
+    health = DeviceHealth(primary=primary, fallback=fallback,
+                          failure_threshold=2, probe_timeout_s=10.0,
+                          recovery_interval_s=0.25, metrics=metrics,
+                          events=obs.events)
+    router = SolverRouter(params)
+    service = SolveService(
+        params=params, ladder=ladder, max_batch=8, max_wait_ms=5.0,
+        queue_capacity=256, metrics=metrics, health=health, obs=obs,
+        continuous=(mode == "continuous"), router=router)
+    round_qps = list(zip(qps, refs))
+    wrong, failures = [], []
+    try:
+        service.start()
+        service.prewarm(qps[0])  # router path: BOTH backends' ladders
+        _, w0, f0, _ = _drive_round(service, round_qps)
+        wrong += w0
+        failures += f0
+        metrics.reset_window()
+
+        submitted = 0
+        half = len(round_qps) // 2
+        # (start-of-round pin, mid-round pin); None = unpinned.
+        flaps = [("pdhg", "admm"), ("admm", "pdhg"), ("pdhg", None),
+                 (None, None)]
+        for start_pin, mid_pin in flaps:
+            router.force(start_pin)
+            tickets = []
+            for i, (qp, ref) in enumerate(round_qps):
+                if i == half:
+                    router.force(mid_pin)
+                tickets.append((i, ref, service.submit(qp)))
+            import numpy as np
+            for i, ref, t in tickets:
+                try:
+                    res = service.result(t, timeout=RESULT_TIMEOUT_S)
+                except Exception as exc:  # noqa: BLE001 - an outcome
+                    failures.append(f"req{i}: {type(exc).__name__}: {exc}")
+                    continue
+                x = np.asarray(res.x)
+                if not np.all(np.isfinite(x)) or \
+                        float(np.max(np.abs(x - ref))) > WRONG_ANSWER_ATOL:
+                    wrong.append(
+                        f"req{i}: max|x-ref|="
+                        f"{float(np.max(np.abs(x - ref))):.2e}"
+                        if np.all(np.isfinite(x))
+                        else f"req{i}: non-finite x")
+                    continue
+            submitted += len(round_qps)
+
+        snap = service.snapshot()
+        invariants = {
+            "zero_wrong_answers": {
+                "ok": not wrong,
+                "detail": wrong[:4],
+            },
+            "both_backends_served": {
+                "ok": (snap.get("routed_admm", 0) >= 1
+                       and snap.get("routed_pdhg", 0) >= 1),
+                "detail": {"routed_admm": snap.get("routed_admm", 0),
+                           "routed_pdhg": snap.get("routed_pdhg", 0)},
+            },
+            "zero_recompiles": {
+                "ok": snap.get("compiles", 0) == 0,
+                "detail": f"{snap.get('compiles', 0)} compile(s) "
+                          f"during the flapping window",
+            },
+            "zero_failures": {
+                "ok": not failures,
+                "detail": failures[:4],
+            },
+        }
+        ok = all(v["ok"] for v in invariants.values())
+        verdict = {
+            "scenario": "solver_route_flap",
+            "mode": mode,
+            "ok": ok,
+            "invariants": invariants,
+            "router": router.snapshot(),
+            "counters": {k: snap[k] for k in (
+                "submitted", "completed", "failed", "compiles",
+                "routed_admm", "routed_pdhg")},
+        }
+        if verbose:
+            state = "ok  " if ok else "FAIL"
+            bad = [k for k, v in invariants.items() if not v["ok"]]
+            print(f"  {state} {'solver_route_flap':<16} {mode:<10} "
+                  f"routed admm/pdhg="
+                  f"{snap.get('routed_admm', 0)}/"
+                  f"{snap.get('routed_pdhg', 0)} failed={len(failures)}"
+                  + (f"  violated: {', '.join(bad)}" if bad else ""),
+                  file=sys.stderr)
+        return verdict
+    finally:
+        service.stop()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--scenarios", default=None,
@@ -469,14 +595,15 @@ def main(argv=None) -> int:
     if args.selftest:
         cells = list(SELFTEST)
     else:
-        names = (list(SCENARIOS) + list(TENANT_CELLS)
+        names = (list(SCENARIOS) + list(TENANT_CELLS) + list(ROUTE_CELLS)
                  if args.scenarios is None
                  else [s.strip() for s in args.scenarios.split(",") if s])
         modes = [m.strip() for m in args.modes.split(",") if m]
+        known = list(SCENARIOS) + list(TENANT_CELLS) + list(ROUTE_CELLS)
         for s in names:
-            if s not in SCENARIOS and s not in TENANT_CELLS:
+            if s not in known:
                 ap.error(f"unknown scenario {s!r} (known: "
-                         f"{', '.join(list(SCENARIOS) + list(TENANT_CELLS))})")
+                         f"{', '.join(known)})")
         for m in modes:
             if m not in MODES:
                 ap.error(f"unknown mode {m!r} (known: {', '.join(MODES)})")
@@ -504,6 +631,11 @@ def main(argv=None) -> int:
                                       verbose=True)
             verdict["scenario"] = verdict.pop("cell")
             results.append(verdict)
+            continue
+        if name in ROUTE_CELLS:
+            results.append(run_route_flap_cell(
+                mode, args.seed, qps, refs, params, ladder,
+                verbose=True))
             continue
         results.append(run_scenario(name, mode, args.seed, qps, refs,
                                     params, ladder, cache, verbose=True))

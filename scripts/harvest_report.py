@@ -66,7 +66,60 @@ def render_table(agg: Dict[str, Any]) -> str:
             f"{g['wasted_iteration_fraction']:>7.3f} "
             f"{g['warm_count']:>5} {g['cold_count']:>5} "
             f"{(f'{wc:+.1f}' if wc is not None else '-'):>9}  {status}")
+    solver_lines = _render_solver_table(agg)
+    if solver_lines:
+        lines += [""] + solver_lines
     return "\n".join(lines)
+
+
+def _solver_winner(by_solver: Dict[str, Dict[str, Any]]) -> str:
+    """The backend this cell's evidence favors — the same ordering
+    :meth:`porqua_tpu.serve.routing.SolverRouter.seed_from_aggregate`
+    uses (solved share first, then mean dispatch latency when every
+    backend has one, then iteration p95, then name), re-stated here
+    host-side so the report needs no JAX import."""
+    have_lat = all(e.get("solve_s_mean") is not None
+                   for e in by_solver.values())
+
+    def score(item):
+        name, e = item
+        solved = e["status_counts"].get("1", 0) / max(e["count"], 1)
+        primary = (e["solve_s_mean"] if have_lat else e["iters"]["p95"])
+        return (-solved, primary, e["iters"]["p95"], name)
+
+    return min(by_solver.items(), key=score)[0]
+
+
+def _render_solver_table(agg: Dict[str, Any]) -> List[str]:
+    """Per-(tenant, bucket, eps) ADMM-vs-PDHG comparison — rendered
+    only when the dataset actually carries the backend axis with more
+    than one backend somewhere (a pure pre-PDHG dataset, where every
+    record reads back as "admm", adds no section). ``win`` marks the
+    backend the routing seed would pick for the cell."""
+    rows = [g for g in agg["groups"] if g.get("by_solver")]
+    if not any(len(g["by_solver"]) > 1 for g in rows):
+        return []
+    lines = [
+        "solver comparison (routing evidence per cell; win = seed pick):",
+        f"{'tenant':<14} {'bucket':<12} {'eps_abs':>9} {'solver':<6} "
+        f"{'count':>6} {'p50':>6} {'p95':>6} {'solve_ms':>9} "
+        f"{'solved%':>8} {'win':>4}",
+    ]
+    for g in rows:
+        eps = g["eps_abs"]
+        winner = _solver_winner(g["by_solver"])
+        for sv, e in sorted(g["by_solver"].items()):
+            lat = e.get("solve_s_mean")
+            solved = (100.0 * e["status_counts"].get("1", 0)
+                      / max(e["count"], 1))
+            lines.append(
+                f"{g.get('tenant', '-'):<14} {g['bucket']:<12} "
+                f"{(f'{eps:.0e}' if eps is not None else '-'):>9} "
+                f"{sv:<6} {e['count']:>6} {e['iters']['p50']:>6.0f} "
+                f"{e['iters']['p95']:>6.0f} "
+                f"{(f'{lat * 1e3:.2f}' if lat is not None else '-'):>9} "
+                f"{solved:>7.0f}% {('*' if sv == winner else ''):>4}")
+    return lines
 
 
 def _selftest() -> int:
@@ -125,6 +178,31 @@ def _selftest() -> int:
     for needle in ("32x4", "512x4", "1e-05", "serve x17", "batch x8",
                    "fund-a", "tenants: default x24, fund-a x1"):
         assert needle in text, f"selftest: {needle!r} missing:\n{text}"
+    # A solver-absent dataset (every record above) renders NO backend
+    # section — those records all read back as "admm" and a
+    # one-backend table says nothing.
+    assert "solver comparison" not in text, text
+
+    # The backend axis: shadow-compare records put both backends in
+    # one cell; the comparison table renders with the seed pick
+    # marked. PDHG solves the cell in a third of the iterations and
+    # half the dispatch latency -> it wins the cell.
+    p_pdhg = SolverParams(eps_abs=1e-3, eps_rel=1e-3, method="pdhg")
+    routed = list(records)
+    for i in range(16):
+        routed.append(solve_record(
+            "serve.shadow", 24, 1, 1, 9, 1e-4, 1e-4, -1.0,
+            params=p_pdhg, bucket="32x4", solve_s=5e-4,
+            shadow_of="admm", delta_iters=-16, agree=True))
+    agg3 = aggregate(routed)
+    cell = next(g for g in agg3["groups"] if g["bucket"] == "32x4")
+    assert set(cell["by_solver"]) == {"admm", "pdhg"}, cell
+    assert _solver_winner(cell["by_solver"]) == "pdhg", cell
+    text3 = render_table(agg3)
+    for needle in ("solver comparison", "pdhg", "serve.shadow x16"):
+        assert needle in text3, f"selftest: {needle!r} missing:\n{text3}"
+    assert text3.count("*") >= 1, text3
+
     print(text)
     print("\nharvest_report selftest: ok")
     return 0

@@ -54,8 +54,13 @@ across a burst backlog, a tenant-labeled per-tenant burn-rate alert
 fired on a stepped clock, a tenant-tagged harvest record, a seeded
 three-tenant workload blend — leaves the solve/serve jaxprs
 string-identical: tenancy is host-side scheduling + attribution
-only, and no compiled program carries a tenant). Exit status: 0
-clean, 1 findings, 2 internal/usage error.
+only, and no compiled program carries a tenant), and the GC110
+routing-identity contract (both solver backends' programs carry the
+GC101-103 proofs, and a live SolverRouter — a harvest-seeded route
+table consulted per bucket, a force() flip, a snapshot — leaves the
+solve/serve jaxprs of BOTH backends string-identical: routing picks
+which compiled program runs, it never touches a traced one). Exit
+status: 0 clean, 1 findings, 2 internal/usage error.
 
 Options:
     --format {text,json}   output format (default text)
@@ -129,7 +134,7 @@ def main(argv=None) -> int:
     if not args.no_contracts and (
             rules is None or rules & {"GC101", "GC102", "GC103", "GC104",
                                       "GC105", "GC106", "GC107",
-                                      "GC108", "GC109"}):
+                                      "GC108", "GC109", "GC110"}):
         try:
             import jax
 

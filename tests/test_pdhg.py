@@ -1,0 +1,234 @@
+"""Contracts for the restarted-PDHG backend (``SolverParams(method="pdhg")``).
+
+Pins what the routing subsystem stands on:
+
+* the steppable PDHG API (``pdhg_init`` / ``pdhg_segment_step``) is
+  bit-identical to the fused ``pdhg_solve`` while_loop (same compiled
+  segment program — the compaction/continuous hoist cannot drift);
+* solutions agree with the ADMM backend on the same problems (shared
+  KKT residual measure, shared finalize), so a routing flip changes
+  wall-clock, never answers;
+* the restart machinery actually fires and is observable through the
+  convergence rings (third slot = cumulative restart count where ADMM
+  records rho);
+* MAX_ITER retirement + active-set polish fallback work for PDHG lanes
+  exactly as for ADMM lanes;
+* the backend-agnostic drivers (vmapped batch solve, compacting
+  driver) accept ``method="pdhg"`` and agree lane-for-lane.
+
+The test family is exposure-banded mean-variance QPs (dense factor P,
+budget row + signed exposure bands) — the production family whose
+general rows are PDHG's winning regime — small enough for CPU CI.
+"""
+
+import dataclasses
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from porqua_tpu.compaction import CompactingDriver
+from porqua_tpu.obs.rings import ring_history
+from porqua_tpu.qp.admm import Status
+from porqua_tpu.qp.canonical import CanonicalQP, stack_qps
+from porqua_tpu.qp.pdhg import pdhg_init, pdhg_segment_step, pdhg_solve
+from porqua_tpu.qp.ruiz import equilibrate
+from porqua_tpu.qp.solve import SolverParams, solve_qp, solve_qp_batch
+
+# Moderate eps: PDHG converges in a few hundred iterations on this
+# family; tight enough that the adaptive restart fires several times.
+PARAMS = SolverParams(method="pdhg", max_iter=2000, eps_abs=1e-5,
+                      eps_rel=1e-5, polish=False, check_interval=25)
+
+N, M, B = 16, 5, 6
+
+
+def _exposure_qp(rng, n=N, m=M, box=0.4):
+    """Dense factor-model P, budget row + signed exposure bands — the
+    loadgen ``build_exposure_requests`` family at test size."""
+    F = rng.standard_normal((max(2, n // 4), n))
+    P = F.T @ F / n + 0.1 * np.eye(n)
+    C = np.concatenate([np.ones((1, n)),
+                        rng.standard_normal((m - 1, n))])
+    l = np.concatenate([[1.0], np.full(m - 1, -1.0)])
+    u = np.ones(m)
+    return CanonicalQP.build(
+        P, rng.standard_normal(n) * 0.1, C=C, l=l, u=u,
+        lb=np.zeros(n), ub=np.full(n, box))
+
+
+def _make_batch():
+    rng = np.random.default_rng(7)
+    return stack_qps([_exposure_qp(rng) for _ in range(B)])
+
+
+@pytest.fixture(scope="module")
+def batch():
+    return _make_batch()
+
+
+# ---------------------------------------------------------------------------
+# steppable API
+# ---------------------------------------------------------------------------
+
+def test_segment_step_matches_pdhg_solve(batch):
+    """A host loop over jitted pdhg_segment_step reproduces the fused
+    while_loop bit-for-bit (the twin of the ADMM stepper contract in
+    test_compaction.py — same hoisted segment program)."""
+    qp = jax.tree.map(lambda a: a[0], batch)
+    scaled, scaling = equilibrate(qp, iters=PARAMS.scaling_iters)
+
+    @functools.partial(jax.jit, static_argnames=("params",))
+    def step(carry, s, sc, params):
+        return pdhg_segment_step(carry, s, sc, params)[0]
+
+    @functools.partial(jax.jit, static_argnames=("params",))
+    def fused_solve(s, sc, params):
+        return pdhg_solve(s, sc, params)
+
+    carry = jax.jit(lambda q: pdhg_init(q, PARAMS))(scaled)
+    n_segments = 0
+    while (int(carry.state.status) == Status.RUNNING
+           and int(carry.state.iters) < PARAMS.max_iter):
+        carry = step(carry, scaled, scaling, PARAMS)
+        n_segments += 1
+    assert n_segments >= 2, "family must take multiple segments"
+    ref = fused_solve(scaled, scaling, PARAMS)
+    got = carry.state._replace(status=jnp.where(
+        carry.state.status == Status.RUNNING, Status.MAX_ITER,
+        carry.state.status).astype(jnp.int32))
+    for name in ("x", "z", "w", "y", "mu", "rho_bar", "iters", "status",
+                 "prim_res", "dual_res"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(got, name)),
+            np.asarray(getattr(ref, name)), err_msg=name)
+
+
+def test_segment_step_never_retires_max_iter(batch):
+    """The stepper leaves budget enforcement to the orchestrator: a
+    lane past ``max_iter`` keeps status RUNNING until a driver (or the
+    fused solve's exit) retires it."""
+    qp = jax.tree.map(lambda a: a[0], batch)
+    short = dataclasses.replace(PARAMS, max_iter=25)
+    scaled, scaling = equilibrate(qp, iters=short.scaling_iters)
+    carry = jax.jit(lambda q: pdhg_init(q, short))(scaled)
+
+    @functools.partial(jax.jit, static_argnames=("params",))
+    def step(c, s, sc, params):
+        return pdhg_segment_step(c, s, sc, params)[0]
+
+    for _ in range(3):  # 3 segments = 75 iters >> max_iter=25
+        carry = step(carry, scaled, scaling, short)
+    assert int(carry.state.iters) == 75
+    assert int(carry.state.status) == Status.RUNNING
+
+
+# ---------------------------------------------------------------------------
+# solution agreement with the ADMM backend
+# ---------------------------------------------------------------------------
+
+def test_pdhg_agrees_with_admm(batch):
+    """Both backends certify SOLVED on every lane and land on the same
+    optimum (shared residual measure -> comparable certificates; the
+    routing flip must never change answers)."""
+    admm_params = dataclasses.replace(PARAMS, method="admm")
+    sol_p = solve_qp_batch(batch, PARAMS)
+    sol_a = solve_qp_batch(batch, admm_params)
+    assert np.all(np.asarray(sol_p.status) == Status.SOLVED), (
+        np.asarray(sol_p.status))
+    assert np.all(np.asarray(sol_a.status) == Status.SOLVED)
+    x_p, x_a = np.asarray(sol_p.x), np.asarray(sol_a.x)
+    np.testing.assert_allclose(x_p, x_a, atol=2e-3)
+    obj_p, obj_a = np.asarray(sol_p.obj_val), np.asarray(sol_a.obj_val)
+    np.testing.assert_allclose(obj_p, obj_a, rtol=1e-3, atol=1e-5)
+    # Certificates are real KKT residuals for this backend too.
+    assert float(np.max(np.asarray(sol_p.prim_res))) < 1e-3
+    assert float(np.max(np.asarray(sol_p.dual_res))) < 1e-3
+
+
+def test_unknown_method_fails_loudly(batch):
+    with pytest.raises(ValueError, match="unknown method"):
+        solve_qp_batch(batch, dataclasses.replace(PARAMS, method="qpth"))
+
+
+# ---------------------------------------------------------------------------
+# restarts + rings
+# ---------------------------------------------------------------------------
+
+def test_restarts_fire_and_ring_records_them(batch):
+    """The adaptive restart actually triggers on this family, and the
+    rings' third slot carries the cumulative restart count (decoded
+    chronologically it is non-decreasing and ends at the carry's
+    total) — the trajectory diagnostic obs/rings exposes."""
+    qp = jax.tree.map(lambda a: a[0], batch)
+    ringed = dataclasses.replace(PARAMS, ring_size=64)
+    scaled, scaling = equilibrate(qp, iters=ringed.scaling_iters)
+    carry = jax.jit(lambda q: pdhg_init(q, ringed))(scaled)
+
+    @functools.partial(jax.jit, static_argnames=("params",))
+    def step(c, s, sc, params):
+        return pdhg_segment_step(c, s, sc, params)[0]
+
+    while (int(carry.state.status) == Status.RUNNING
+           and int(carry.state.iters) < ringed.max_iter):
+        carry = step(carry, scaled, scaling, ringed)
+
+    n_restarts = int(carry.restart_count)
+    assert n_restarts >= 1, "restart machinery never fired"
+    hist = ring_history(carry.state.ring_prim, carry.state.ring_dual,
+                        carry.state.ring_rho, int(carry.state.iters),
+                        ringed.check_interval)
+    counts = hist["rho"]  # PDHG: cumulative restart count per segment
+    assert counts == sorted(counts), counts
+    assert int(counts[-1]) == n_restarts, (counts, n_restarts)
+    # The trajectory converged: final ring sample equals the state's
+    # residuals exactly (polish=False contract from qp/solve.py).
+    assert hist["prim_res"][-1] == float(carry.state.prim_res)
+    assert hist["dual_res"][-1] == float(carry.state.dual_res)
+
+
+# ---------------------------------------------------------------------------
+# MAX_ITER retirement + polish fallback
+# ---------------------------------------------------------------------------
+
+def test_max_iter_polish_fallback(batch):
+    """A PDHG lane retired out of budget still gets the active-set
+    polish and is re-graded SOLVED when the polished point meets
+    tolerance — the same finalize contract as ADMM lanes."""
+    qp = jax.tree.map(lambda a: a[0], batch)
+    starved = dataclasses.replace(PARAMS, max_iter=50)
+    raw = solve_qp(qp, starved)
+    assert int(raw.status) == Status.MAX_ITER
+    polished = solve_qp(qp, dataclasses.replace(starved, polish=True))
+    assert int(polished.iters) == 50  # polish adds accuracy, not iters
+    assert float(polished.prim_res) <= float(raw.prim_res)
+    assert float(polished.dual_res) <= float(raw.dual_res)
+    # On this well-conditioned family one polish pass reaches
+    # tolerance from 50 PDHG iterations -> the re-grade fires.
+    assert int(polished.status) == Status.SOLVED
+
+
+# ---------------------------------------------------------------------------
+# backend-agnostic drivers
+# ---------------------------------------------------------------------------
+
+def test_compaction_parity_with_pdhg(batch):
+    """The compacting driver is backend-agnostic: with method="pdhg"
+    converged lanes are bit-identical to the vmapped fused solve, in
+    the original lane order, with zero post-prewarm compiles."""
+    fused = solve_qp_batch(batch, PARAMS)
+    driver = CompactingDriver(PARAMS)
+    compiled = driver.prewarm(B, N, M)
+    assert compiled > 0
+    sol, rep = driver.solve(batch)
+    assert rep.compiles == 0, "prewarmed solve must not compile"
+    status = np.asarray(fused.status)
+    assert np.all(status == Status.SOLVED)
+    np.testing.assert_array_equal(np.asarray(sol.status), status)
+    for name in ("x", "z", "y", "mu", "iters", "prim_res", "dual_res"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(sol, name)),
+            np.asarray(getattr(fused, name)), err_msg=name)

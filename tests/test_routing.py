@@ -1,0 +1,212 @@
+"""Per-(bucket, eps) solver routing (porqua_tpu.serve.routing).
+
+Host-side contracts (no compiles): constructor/force validation, the
+harvest-seeded route table (solved share > dispatch latency >
+iteration p95 > name — one-sided cells keep the default), decision
+counters, the service/router params handshake.
+
+One end-to-end service test (compiles two tiny ladders once): routed
+serving returns correct answers under shadow-compare, prewarm covers
+BOTH backends so a mid-stream force flip dispatches with zero new
+compiles, per-tenant ``routed_*`` attribution lands in the metrics
+snapshot, and shadow lanes reach the harvest warehouse as
+``serve.shadow`` records carrying the loser's outcome + deltas.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from porqua_tpu.obs.harvest import HarvestSink, aggregate, solve_record
+from porqua_tpu.qp.admm import Status
+from porqua_tpu.qp.solve import SolverParams, solve_qp
+from porqua_tpu.serve import Bucket, BucketLadder, SolveService
+from porqua_tpu.serve.routing import METHODS, SolverRouter
+
+from tests.test_serve import make_qp
+
+PARAMS = SolverParams(max_iter=500, eps_abs=1e-5, eps_rel=1e-5,
+                      polish=False, check_interval=25)
+EPS = float(PARAMS.eps_abs)
+PDHG = dataclasses.replace(PARAMS, method="pdhg")
+
+
+def _records(bucket, method, n, *, iters, status=int(Status.SOLVED),
+             solve_s=None):
+    p = dataclasses.replace(PARAMS, method=method)
+    return [solve_record("serve", 6, 2, status, iters, 1e-6, 1e-6,
+                         -1.0, params=p, bucket=bucket, solve_s=solve_s)
+            for _ in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# validation
+# ---------------------------------------------------------------------------
+
+def test_router_validation():
+    with pytest.raises(ValueError, match="unknown method"):
+        SolverRouter(dataclasses.replace(PARAMS, method="qpth"))
+    with pytest.raises(ValueError, match="shadow_rate"):
+        SolverRouter(PARAMS, shadow_rate=1.5)
+    router = SolverRouter(PARAMS)
+    with pytest.raises(ValueError, match="unknown method"):
+        router.force("qpth")
+    # Per-backend caches differ exactly by method.
+    assert set(router.caches) == set(METHODS)
+    assert router.params_for("pdhg") == PDHG
+    assert router.params == PARAMS
+
+
+def test_service_router_handshake():
+    from porqua_tpu.serve import ExecutableCache
+    router = SolverRouter(PARAMS)
+    with pytest.raises(ValueError, match="not both"):
+        SolveService(PARAMS, router=router, cache=ExecutableCache(PARAMS))
+    with pytest.raises(ValueError, match="different"):
+        SolveService(dataclasses.replace(PARAMS, eps_abs=1e-3),
+                     router=router)
+
+
+# ---------------------------------------------------------------------------
+# harvest-seeded routing
+# ---------------------------------------------------------------------------
+
+def test_seed_from_aggregate():
+    recs = []
+    # Cell 8x4: both solved, pdhg 5x lower dispatch latency -> pdhg.
+    recs += _records("8x4", "admm", 10, iters=100, solve_s=5e-3)
+    recs += _records("8x4", "pdhg", 10, iters=300, solve_s=1e-3)
+    # Cell 16x4: pdhg is faster but runs out of iterations half the
+    # time -> solved share rules, admm wins.
+    recs += _records("16x4", "admm", 10, iters=100, solve_s=5e-3)
+    recs += _records("16x4", "pdhg", 5, iters=500, solve_s=1e-3,
+                     status=int(Status.MAX_ITER))
+    recs += _records("16x4", "pdhg", 5, iters=400, solve_s=1e-3)
+    # Cell 16x8: one-sided evidence -> no route written.
+    recs += _records("16x8", "pdhg", 10, iters=50, solve_s=1e-3)
+    # Cell 32x4: admm never recorded latency -> iteration p95 decides.
+    recs += _records("32x4", "admm", 10, iters=100)
+    recs += _records("32x4", "pdhg", 10, iters=40, solve_s=1e-3)
+
+    router = SolverRouter(PARAMS)
+    written = router.seed_from_aggregate(aggregate(recs))
+    assert written == {f"8x4@{EPS:.0e}": "pdhg",
+                       f"16x4@{EPS:.0e}": "admm",
+                       f"32x4@{EPS:.0e}": "pdhg"}, written
+
+    assert router.route(Bucket(8, 4, None)) == "pdhg"
+    assert router.route(Bucket(16, 4, None)) == "admm"
+    assert router.route(Bucket(32, 4, None)) == "pdhg"
+    # One-sided and unseen cells fall back to the service default.
+    assert router.route(Bucket(16, 8, None)) == "admm"
+    assert router.route(Bucket(64, 4, None)) == "admm"
+    assert router.decisions() == {"admm": 3, "pdhg": 2}
+
+    # decide() resolves to the matching backend's executable cache.
+    method, cache = router.decide(Bucket(8, 4, None))
+    assert method == "pdhg" and cache is router.caches["pdhg"]
+
+    snap = router.snapshot()
+    assert snap["table"][f"8x4@{EPS:.0e}"] == "pdhg"
+    assert snap["forced"] is None and snap["default_method"] == "admm"
+
+
+def test_force_overrides_table():
+    router = SolverRouter(PARAMS)
+    recs = (_records("8x4", "admm", 4, iters=100, solve_s=5e-3)
+            + _records("8x4", "pdhg", 4, iters=50, solve_s=1e-3))
+    router.seed_from_aggregate(aggregate(recs))
+    b = Bucket(8, 4, None)
+    assert router.route(b) == "pdhg"
+    router.force("admm")
+    assert router.route(b) == "admm"
+    assert router.snapshot()["forced"] == "admm"
+    router.force(None)
+    assert router.route(b) == "pdhg"
+
+
+def test_seed_pools_across_tenants():
+    """Evidence for one (bucket, eps) cell pools across tenants — the
+    compiled programs are tenant-blind, so the winner must be too."""
+    recs = []
+    for tenant in ("fund-a", "fund-b"):
+        for method, s in (("admm", 5e-3), ("pdhg", 1e-3)):
+            p = dataclasses.replace(PARAMS, method=method)
+            recs += [solve_record("serve", 6, 2, 1, 100, 1e-6, 1e-6,
+                                  -1.0, params=p, bucket="8x4",
+                                  solve_s=s, tenant=tenant)
+                     for _ in range(4)]
+    agg = aggregate(recs)
+    assert len([g for g in agg["groups"] if g["bucket"] == "8x4"]) == 2
+    router = SolverRouter(PARAMS)
+    assert router.seed_from_aggregate(agg) == {f"8x4@{EPS:.0e}": "pdhg"}
+
+
+# ---------------------------------------------------------------------------
+# routed serving end to end
+# ---------------------------------------------------------------------------
+
+def test_routed_service_shadow_and_flip():
+    qps = [make_qp(6, 2, seed=s) for s in range(6)]
+    refs = [np.asarray(solve_qp(q, PARAMS).x) for q in qps]
+    ladder = BucketLadder(n_rungs=(8,), m_rungs=(4,))
+    harvest = HarvestSink()  # in-memory buffer
+    router = SolverRouter(PARAMS, shadow_rate=1.0, shadow_seed=0)
+    with SolveService(PARAMS, ladder=ladder, max_batch=2,
+                      max_wait_ms=5.0, router=router,
+                      harvest=harvest) as svc:
+        # Prewarm compiles BOTH backends' ladders (2 slots x 2
+        # methods x {solve}) — the flip below must not retrace.
+        assert svc.prewarm(qps[0]) > 0
+        compiles_warm = svc.snapshot()["compiles"]
+        assert compiles_warm >= 4
+
+        for q, ref, tenant in zip(qps[:4], refs[:4],
+                                  ("fund-a", "fund-a", "fund-b", None)):
+            r = svc.solve(q, timeout=120, tenant=tenant)
+            np.testing.assert_allclose(r.x, ref, atol=5e-4)
+
+        # Mid-stream force flip: the next dispatches run PDHG out of
+        # the prewarmed cache — same answers, zero new compiles.
+        router.force("pdhg")
+        for q, ref in zip(qps[4:], refs[4:]):
+            np.testing.assert_allclose(svc.solve(q, timeout=120).x,
+                                       ref, atol=5e-4)
+    # Snapshot after stop: shadows run on the dispatch thread after
+    # the primary futures resolve, so an in-flight snapshot could
+    # still miss the final shadow's accounting.
+    snap = svc.snapshot()
+    assert snap["compiles"] == compiles_warm
+    assert snap["completed"] == 6 and snap["failed"] == 0
+    assert snap["routed_admm"] >= 4 and snap["routed_pdhg"] >= 2
+    # Per-tenant attribution.
+    assert snap["tenants"]["fund-a"]["routed_admm"] == 2
+    assert snap["tenants"]["fund-b"]["routed_admm"] == 1
+    assert snap["shadow_solves"] >= 1
+
+    rsnap = router.snapshot()
+    assert rsnap["forced"] == "pdhg"
+    assert rsnap["decisions"]["pdhg"] >= 2
+    assert rsnap["shadow_solves"] == snap["shadow_solves"]
+    assert rsnap["shadow_failures"] == 0
+
+    # Shadow lanes landed in the warehouse as serve.shadow records
+    # carrying the alternate backend's outcome + delta vs the served
+    # answer — the evidence seed_from_aggregate consumes.
+    shadows = [r for r in harvest.buffered()
+               if r["source"] == "serve.shadow"]
+    assert shadows, "shadow_rate=1.0 must shadow every dispatch"
+    for r in shadows:
+        assert r["shadow_of"] in METHODS
+        assert r["solver"] in METHODS and r["solver"] != r["shadow_of"]
+        assert isinstance(r["delta_iters"], int)
+        assert isinstance(r["agree"], bool)
+        assert r["bucket"] == "8x4"
+    # Both directions observed (admm-primary before the flip,
+    # pdhg-primary after).
+    assert {r["shadow_of"] for r in shadows} == set(METHODS)
+    # The aggregate's backend axis picks both solvers up.
+    cell = next(g for g in aggregate(harvest.buffered())["groups"]
+                if g.get("by_solver") and len(g["by_solver"]) > 1)
+    assert set(cell["by_solver"]) <= set(METHODS)
