@@ -653,6 +653,11 @@ def device_child(platform: str, n_dates: int) -> None:
             else:
                 log(f"skipping cpu serving config "
                     f"({child_left():.0f}s left)")
+            if child_left() > 300:
+                _secondary_config_hlo(child_left)
+            else:
+                log(f"skipping cpu hlo lint harvest "
+                    f"({child_left():.0f}s left)")
         except Exception as e:  # pragma: no cover - best-effort extras
             log(f"cpu secondary metrics aborted: {type(e).__name__}: {e}")
         return
@@ -703,6 +708,10 @@ def device_child(platform: str, n_dates: int) -> None:
             _secondary_config_serving(child_left)
         else:
             log(f"skipping serving config ({child_left():.0f}s left)")
+        if child_left() > 300:
+            _secondary_config_hlo(child_left)
+        else:
+            log(f"skipping hlo lint harvest ({child_left():.0f}s left)")
     except Exception as e:  # pragma: no cover - best-effort extras
         log(f"secondary metrics aborted: {type(e).__name__}: {e}")
 
@@ -1213,6 +1222,42 @@ def _secondary_config_sketch(child_left, n_assets=2048, window=504,
         f"gram_rel_err {payload['gram_rel_err']:.3f}; TE drift rel "
         f"{payload['te_rel_drift']:.3f}; off-path drift "
         f"{payload['sketch_off_te_drift']:.2e}")
+
+
+def _secondary_config_hlo(child_left):
+    """Post-lowering HLO lint part: harvest every entry-point program
+    through ``jit(...).lower(...).compile()``
+    (:mod:`porqua_tpu.analysis.hlo`), lint the optimized HLO against
+    the committed ``HLO_BASELINE.json`` budgets, and emit the summary
+    the bench-gate hlo rule class holds — GC201-GC206 finding counts
+    vs the committed floor, HLO fingerprint flips, program coverage,
+    and the top fusion target's measured bytes. CPU-only: the
+    committed baseline's fingerprints are CPU-lowered HLO, and a TPU
+    harvest would flip every one of them by construction
+    (``hlolint_report.py --harvest`` on the target platform builds a
+    per-platform baseline). The heaviest secondary (~20 AOT
+    compiles), so it sits behind the fattest budget gate;
+    ``hlolint_report.py --bench-part`` emits the same part without a
+    bench run."""
+    import jax
+
+    from porqua_tpu.analysis import hlo
+
+    platform = jax.devices()[0].platform
+    if platform != "cpu":
+        log(f"config hlo: skipped on {platform} (the committed "
+            "baseline fingerprints CPU-lowered HLO)")
+        return
+    log("config hlo (post-lowering lint harvest)...")
+    t0 = time.perf_counter()
+    part = hlo.bench_hlo_part()
+    payload = {"part": "config_hlo", **part,
+               "harvest_s": round(time.perf_counter() - t0, 2)}
+    _emit(payload)
+    log(f"config hlo: {part['programs']} programs, "
+        f"{part['findings_total']} finding(s), "
+        f"{part['fingerprint_flips']} fingerprint flip(s) in "
+        f"{payload['harvest_s']:.0f}s")
 
 
 def _secondary_config_routing(child_left, n_small=24, n_large=96,

@@ -15,6 +15,13 @@ Four enforcement layers (see each submodule's docstring):
 * :mod:`porqua_tpu.analysis.contracts` — GC101-GC103, trace-time jaxpr
   contracts on the public batch entry points (imports JAX; loaded
   lazily so the lint path stays light).
+* :mod:`porqua_tpu.analysis.hlolint` — GC201-GC206, post-lowering
+  rules over optimized HLO text (fusion miss, redundant
+  materialization, layout churn, padding waste, temp-peak budget,
+  dtype drift). Pure stdlib; the companion harvester
+  :mod:`porqua_tpu.analysis.hlo` compiles every entry-point program
+  via ``jit(...).lower(...).compile()`` and is loaded lazily (it
+  needs JAX and real compile time).
 * :mod:`porqua_tpu.analysis.sanitize` — the ``PORQUA_SANITIZE=1``
   runtime mode: ``jax.transfer_guard`` around solver dispatches and a
   hard zero-recompiles-after-warmup assertion in serving.
@@ -51,16 +58,19 @@ __all__ = [
     "sanitize",
     "tsan",
     "contracts",
+    "hlo",
+    "hlolint",
 ]
 
 
 def __getattr__(name):
-    # `contracts` imports porqua_tpu.qp/batch at call time; loading it
-    # lazily keeps this package free of import cycles with
+    # `contracts` and `hlo` import porqua_tpu.qp/batch at call time;
+    # loading them lazily keeps this package free of import cycles with
     # porqua_tpu.batch (which imports `sanitize` from here) and skips
-    # the tracer machinery when only the AST rules are wanted.
-    if name == "contracts":
+    # the tracer/harvester machinery when only the AST rules are
+    # wanted. `hlolint` is stdlib-light but pulled lazily for symmetry.
+    if name in ("contracts", "hlo", "hlolint"):
         import importlib
 
-        return importlib.import_module("porqua_tpu.analysis.contracts")
+        return importlib.import_module(f"porqua_tpu.analysis.{name}")
     raise AttributeError(name)

@@ -50,10 +50,15 @@ __all__ = [
     "check_telemetry_identity",
     "check_tenancy_identity",
     "compaction_step_jaxpr",
+    "compaction_step_program",
     "continuous_jaxprs",
+    "continuous_programs",
     "solve_batch_jaxpr",
+    "solve_batch_program",
     "serve_entry_jaxpr",
+    "serve_entry_program",
     "tracking_jaxpr",
+    "tracking_program",
 ]
 
 #: primitive names that imply a host round-trip or transfer
@@ -140,25 +145,40 @@ def check_closed_jaxpr(closed: ClosedJaxpr, label: str,
 # entry-point tracers
 # ---------------------------------------------------------------------------
 
-def solve_batch_jaxpr(batch: int = 4, n: int = 16, m: int = 4,
-                      factor_rows: Optional[int] = None,
-                      params=None, dtype=np.float32) -> ClosedJaxpr:
-    """Trace the batched solve exactly as ``solve_qp_batch`` /
-    ``solve_batch`` run it (shared ``_solve_batch_impl``)."""
+def solve_batch_program(batch: int = 4, n: int = 16, m: int = 4,
+                        factor_rows: Optional[int] = None,
+                        params=None, dtype=np.float32):
+    """The ``(fn, example_args)`` pair behind the batched solve —
+    exactly what ``solve_qp_batch`` / ``solve_batch`` run (shared
+    ``_solve_batch_impl``). The jaxpr contracts trace it; the HLO
+    harvester (:mod:`porqua_tpu.analysis.hlo`) lowers the same closure
+    through ``jit(...).lower(...).compile()`` so both planes check one
+    program, not two reconstructions of it."""
     from porqua_tpu.qp.solve import (
         SolverParams, _solve_batch_impl, batch_shape_struct)
 
     params = SolverParams() if params is None else params
     struct = batch_shape_struct(batch, n, m, dtype=dtype,
                                 factor_rows=factor_rows)
-    return jax.make_jaxpr(lambda qp: _solve_batch_impl(qp, params))(struct)
+    return (lambda qp: _solve_batch_impl(qp, params)), (struct,)
 
 
-def serve_entry_jaxpr(batch: int = 4, n: int = 16, m: int = 4,
+def solve_batch_jaxpr(batch: int = 4, n: int = 16, m: int = 4,
                       factor_rows: Optional[int] = None,
                       params=None, dtype=np.float32) -> ClosedJaxpr:
-    """Trace the serving AOT executable body (the ``entry`` that
-    ``aot_compile_batch`` lowers: batch solve + warm-start inputs)."""
+    """Trace the batched solve exactly as ``solve_qp_batch`` /
+    ``solve_batch`` run it (shared ``_solve_batch_impl``)."""
+    fn, args = solve_batch_program(batch, n, m, factor_rows=factor_rows,
+                                   params=params, dtype=dtype)
+    return jax.make_jaxpr(fn)(*args)
+
+
+def serve_entry_program(batch: int = 4, n: int = 16, m: int = 4,
+                        factor_rows: Optional[int] = None,
+                        params=None, dtype=np.float32):
+    """``(fn, example_args)`` for the serving AOT executable body (the
+    ``entry`` that ``aot_compile_batch`` lowers: batch solve +
+    warm-start inputs)."""
     from porqua_tpu.qp.solve import (
         SolverParams, _solve_batch_impl, batch_shape_struct)
 
@@ -167,36 +187,52 @@ def serve_entry_jaxpr(batch: int = 4, n: int = 16, m: int = 4,
                                 factor_rows=factor_rows)
     x0 = jax.ShapeDtypeStruct((batch, n), dtype)
     y0 = jax.ShapeDtypeStruct((batch, m), dtype)
-    return jax.make_jaxpr(
-        lambda qp, xx, yy: _solve_batch_impl(qp, params, xx, yy)
-    )(struct, x0, y0)
+    return (lambda qp, xx, yy: _solve_batch_impl(qp, params, xx, yy)), \
+        (struct, x0, y0)
 
 
-def tracking_jaxpr(batch: int = 2, window: int = 8, n_assets: int = 6,
-                   params=None, dtype=np.float32) -> ClosedJaxpr:
-    """Trace the flagship tracking backtest step (build + solve +
-    evaluate in one program)."""
+def serve_entry_jaxpr(batch: int = 4, n: int = 16, m: int = 4,
+                      factor_rows: Optional[int] = None,
+                      params=None, dtype=np.float32) -> ClosedJaxpr:
+    """Trace the serving AOT executable body (the ``entry`` that
+    ``aot_compile_batch`` lowers: batch solve + warm-start inputs)."""
+    fn, args = serve_entry_program(batch, n, m, factor_rows=factor_rows,
+                                   params=params, dtype=dtype)
+    return jax.make_jaxpr(fn)(*args)
+
+
+def tracking_program(batch: int = 2, window: int = 8, n_assets: int = 6,
+                     params=None, dtype=np.float32):
+    """``(fn, example_args)`` for the flagship tracking backtest step
+    (build + solve + evaluate in one program)."""
     from porqua_tpu.qp.solve import SolverParams
     from porqua_tpu.tracking import tracking_step
 
     params = SolverParams() if params is None else params
     Xs = jax.ShapeDtypeStruct((batch, window, n_assets), dtype)
     ys = jax.ShapeDtypeStruct((batch, window), dtype)
-    return jax.make_jaxpr(
-        lambda X, y: tracking_step(X, y, params))(Xs, ys)
+    return (lambda X, y: tracking_step(X, y, params)), (Xs, ys)
 
 
-def compaction_step_jaxpr(batch: int = 6, group: int = 4,
-                          n: int = 16, m: int = 4,
-                          factor_rows: Optional[int] = None,
-                          params=None, dtype=np.float32) -> ClosedJaxpr:
-    """Trace the compaction driver's step-and-repack program exactly as
+def tracking_jaxpr(batch: int = 2, window: int = 8, n_assets: int = 6,
+                   params=None, dtype=np.float32) -> ClosedJaxpr:
+    """Trace the flagship tracking backtest step (build + solve +
+    evaluate in one program)."""
+    fn, args = tracking_program(batch, window, n_assets,
+                                params=params, dtype=dtype)
+    return jax.make_jaxpr(fn)(*args)
+
+
+def compaction_step_program(batch: int = 6, group: int = 4,
+                            n: int = 16, m: int = 4,
+                            factor_rows: Optional[int] = None,
+                            params=None, dtype=np.float32):
+    """``(fn, example_args)`` for the compaction driver's
+    step-and-repack program exactly as
     :class:`porqua_tpu.compaction.CompactingDriver` compiles it: one
     segment over a ``group``-wide compacted lane set, the per-lane
     freeze/select, the scatter-back into the ``batch``-wide result
-    buffer, and the stable active-first repack. GC102 on this program
-    is the machine-checked form of "the repack introduces no host
-    syncs or transfers"."""
+    buffer, and the stable active-first repack."""
     from porqua_tpu.compaction import step_and_repack
     from porqua_tpu.qp.solve import (
         SolverParams, batch_shape_struct, prepare_batch)
@@ -213,16 +249,29 @@ def compaction_step_jaxpr(batch: int = 6, group: int = 4,
     segl_s = jax.ShapeDtypeStruct((group,), np.int32)
     group_s = (take(scaled_s), take(scaling_s), take(carry_s),
                None, None, idx_s, segl_s)
-    return jax.make_jaxpr(
-        lambda buf, grp: step_and_repack(buf, grp, params))(buf_s, group_s)
+    return (lambda buf, grp: step_and_repack(buf, grp, params)), \
+        (buf_s, group_s)
 
 
-def continuous_jaxprs(batch: int = 4, n: int = 16, m: int = 4,
-                      factor_rows: Optional[int] = None,
-                      params=None, dtype=np.float32):
-    """Trace the continuous-batching executable triple (admit / step /
+def compaction_step_jaxpr(batch: int = 6, group: int = 4,
+                          n: int = 16, m: int = 4,
+                          factor_rows: Optional[int] = None,
+                          params=None, dtype=np.float32) -> ClosedJaxpr:
+    """Trace the compaction driver's step-and-repack program. GC102 on
+    this program is the machine-checked form of "the repack introduces
+    no host syncs or transfers"."""
+    fn, args = compaction_step_program(batch, group, n, m,
+                                       factor_rows=factor_rows,
+                                       params=params, dtype=dtype)
+    return jax.make_jaxpr(fn)(*args)
+
+
+def continuous_programs(batch: int = 4, n: int = 16, m: int = 4,
+                        factor_rows: Optional[int] = None,
+                        params=None, dtype=np.float32):
+    """The continuous-batching executable triple (admit / step /
     finalize) — the same closures ``aot_compile_continuous`` lowers —
-    as ``[(label, ClosedJaxpr)]``."""
+    as ``[(label, fn, example_args)]``."""
     from porqua_tpu.qp.solve import (
         SolverParams, batch_shape_struct, continuous_entries,
         prepare_batch)
@@ -238,13 +287,23 @@ def continuous_jaxprs(batch: int = 4, n: int = 16, m: int = 4,
         qp_s, x0_s, y0_s)
     admit, step, fin = continuous_entries(params)
     return [
-        ("continuous_admit", jax.make_jaxpr(admit)(
-            qp_s, x0_s, y0_s, mask_s, scaled_s, scaling_s, carry_s)),
-        ("continuous_step", jax.make_jaxpr(step)(
-            scaled_s, scaling_s, carry_s, mask_s)),
-        ("continuous_finalize", jax.make_jaxpr(fin)(
-            qp_s, scaled_s, scaling_s, carry_s.state)),
+        ("continuous_admit", admit,
+         (qp_s, x0_s, y0_s, mask_s, scaled_s, scaling_s, carry_s)),
+        ("continuous_step", step, (scaled_s, scaling_s, carry_s, mask_s)),
+        ("continuous_finalize", fin,
+         (qp_s, scaled_s, scaling_s, carry_s.state)),
     ]
+
+
+def continuous_jaxprs(batch: int = 4, n: int = 16, m: int = 4,
+                      factor_rows: Optional[int] = None,
+                      params=None, dtype=np.float32):
+    """Trace the continuous-batching executable triple (admit / step /
+    finalize) as ``[(label, ClosedJaxpr)]``."""
+    return [(label, jax.make_jaxpr(fn)(*args))
+            for label, fn, args in continuous_programs(
+                batch, n, m, factor_rows=factor_rows,
+                params=params, dtype=dtype)]
 
 
 def check_resilience_identity(dtype=np.float32) -> List[Finding]:

@@ -1,0 +1,434 @@
+"""Post-lowering HLO harvester: every entry-point program, compiled.
+
+The jaxpr contracts (:mod:`.contracts`) enumerate the programs this
+repo serves — solve/serve AOT entries (both solver backends, i.e. the
+routed dispatch pair), the factored and ring-telemetry variants, the
+tracking step, the compaction step-and-repack, and the
+continuous-batching admit/step/finalize triple. This module lowers the
+*same* closures (the ``*_program`` builders in :mod:`.contracts`, so
+there is one definition of "the program") through
+``jit(...).lower(...).compile()`` and captures what XLA actually
+emitted: the optimized-HLO ``as_text()``, ``cost_analysis()`` flops /
+bytes, ``memory_analysis()`` peak, and the stable per-program HLO
+fingerprint — all through :mod:`porqua_tpu.obs.devprof`'s CostRecord
+constructor, so the harvest lands in the same warehouse schema the
+roofline verdict reads.
+
+On top of the harvest sit the post-lowering lint harness
+(:func:`lint_harvest` — drives :mod:`.hlolint`'s GC201-GC206 rules
+with the committed per-program budgets) and the baseline plumbing
+(``HLO_BASELINE.json``: fingerprints, measured cost, peak budgets,
+padding cells, and the — empty — suppression table). A fingerprint
+flip against the baseline on an unchanged source tree names the
+program that re-lowered differently; ``scripts/hlolint_report.py``
+renders that join and ``bench_gate.py``'s hlo rule class holds the
+finding counts and top-target bytes against the baseline and the
+ledger trend.
+
+Harvesting compiles every program (~seconds each on XLA-CPU), so it is
+opt-in everywhere: ``run_checks.py --hlo``, ``hlolint_report.py``, and
+the ``config_hlo`` bench part guard it behind explicit flags/budgets.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from porqua_tpu.analysis import hlolint
+from porqua_tpu.analysis.lint import Finding
+
+__all__ = [
+    "BASELINE_SCHEMA_VERSION",
+    "DEFAULT_BASELINE_PATH",
+    "HarvestedProgram",
+    "bench_hlo_part",
+    "bucket_padding_cells",
+    "build_baseline",
+    "compare_fingerprints",
+    "entry_point_programs",
+    "harvest_entry_points",
+    "lint_harvest",
+    "load_baseline",
+    "lower_program",
+    "padding_findings",
+]
+
+#: Bump when a baseline field changes meaning.
+BASELINE_SCHEMA_VERSION = 1
+
+#: The committed fingerprint/budget artifact, repo root.
+DEFAULT_BASELINE_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "HLO_BASELINE.json")
+
+#: Peak-memory headroom the baseline grants each program: the GC205
+#: bound is ``peak_bytes * PEAK_HEADROOM`` at baseline-build time, so
+#: jitter passes and a real live-range regression (lost fusion, new
+#: temporary) fails.
+PEAK_HEADROOM = 1.25
+
+
+def entry_point_programs(dtype=np.float32,
+                         factor_rows: int = 8,
+                         ring_size: int = 8):
+    """Every lowerable program ``contracts.check_entry_points`` sweeps,
+    as ``[(label, fn, example_args)]`` — the identity checks
+    (GC104-GC110) are properties of *these* programs, not extra ones.
+    The labels match the contract sweep's so a finding, a CostRecord,
+    and a jaxpr contract all name the same program. ``serve_entry`` /
+    ``serve_entry[pdhg]`` are the routed dispatch pair — the two
+    executables :class:`porqua_tpu.serve.routing.SolverRouter` picks
+    between."""
+    from porqua_tpu.analysis import contracts
+    from porqua_tpu.qp.solve import SolverParams
+
+    progs: List[Tuple[str, Any, tuple]] = []
+
+    def add(label: str, pair) -> None:
+        fn, args = pair
+        progs.append((label, fn, args))
+
+    add("solve_batch", contracts.solve_batch_program(dtype=dtype))
+    add("solve_batch[factored]", contracts.solve_batch_program(
+        factor_rows=factor_rows, dtype=dtype))
+    add("serve_entry", contracts.serve_entry_program(dtype=dtype))
+    add("serve_entry[factored]", contracts.serve_entry_program(
+        factor_rows=factor_rows, dtype=dtype))
+    add("tracking_step", contracts.tracking_program(dtype=dtype))
+    if ring_size:
+        rings = SolverParams(ring_size=ring_size)
+        add("solve_batch[rings]", contracts.solve_batch_program(
+            params=rings, dtype=dtype))
+        add("serve_entry[rings]", contracts.serve_entry_program(
+            params=rings, dtype=dtype))
+    add("compaction_step", contracts.compaction_step_program(dtype=dtype))
+    add("compaction_step[factored]", contracts.compaction_step_program(
+        factor_rows=factor_rows, dtype=dtype))
+    for label, fn, args in contracts.continuous_programs(dtype=dtype):
+        progs.append((label, fn, args))
+    pdhg = SolverParams(method="pdhg")
+    add("solve_batch[pdhg]", contracts.solve_batch_program(
+        params=pdhg, dtype=dtype))
+    add("serve_entry[pdhg]", contracts.serve_entry_program(
+        params=pdhg, dtype=dtype))
+    if ring_size:
+        add("solve_batch[pdhg,rings]", contracts.solve_batch_program(
+            params=SolverParams(method="pdhg", ring_size=ring_size),
+            dtype=dtype))
+    add("compaction_step[pdhg]", contracts.compaction_step_program(
+        params=pdhg, dtype=dtype))
+    for label, fn, args in contracts.continuous_programs(
+            params=pdhg, dtype=dtype):
+        progs.append((f"{label}[pdhg]", fn, args))
+    return progs
+
+
+@dataclasses.dataclass
+class HarvestedProgram:
+    """One lowered entry point and everything the lint reads off it."""
+
+    label: str
+    hlo_text: str
+    fingerprint: Optional[str]
+    flops: Optional[float]
+    bytes_accessed: Optional[float]
+    memory: Dict[str, Optional[float]]
+    compile_s: float
+    record: Dict[str, Any]  #: the devprof CostRecord (warehouse schema)
+
+    @property
+    def peak_bytes(self) -> Optional[float]:
+        return self.memory.get("peak_bytes")
+
+    def parse(self) -> "hlolint.HloModule":
+        return hlolint.parse_hlo(self.hlo_text)
+
+
+def lower_program(label: str, fn, args,
+                  cost_log=None) -> HarvestedProgram:
+    """Lower + compile one program and capture the device truth. The
+    CostRecord goes through :func:`porqua_tpu.obs.devprof.cost_record`
+    (kind ``"hlolint"``) so the harvest shares the warehouse schema —
+    and optionally lands in a live :class:`~porqua_tpu.obs.devprof.CostLog`."""
+    import jax
+
+    from porqua_tpu.obs.devprof import (
+        cost_record, executable_cost, executable_memory)
+
+    t0 = time.perf_counter()
+    # Pin x64 off for the lowering: the committed fingerprints must be
+    # invariant to ambient config (the test suite flips jax_enable_x64
+    # globally, which re-lowers weak-typed scalars as f64 and flips
+    # every hash).
+    with jax.experimental.disable_x64():
+        compiled = jax.jit(fn).lower(*args).compile()
+    compile_s = time.perf_counter() - t0
+    device = jax.devices()[0].platform
+    rec = cost_record(compiled, entry=label, kind="hlolint",
+                      device=device, compile_s=compile_s)
+    if cost_log is not None:
+        cost_log.emit(rec)
+    try:
+        text = compiled.as_text() or ""
+    except Exception:  # noqa: BLE001 - text capture is best-effort
+        text = ""
+    cost = executable_cost(compiled)
+    return HarvestedProgram(
+        label=label, hlo_text=text, fingerprint=rec.get("hlo_hash"),
+        flops=cost.get("flops"),
+        bytes_accessed=cost.get("bytes_accessed"),
+        memory=executable_memory(compiled), compile_s=compile_s,
+        record=rec)
+
+
+def harvest_entry_points(dtype=np.float32,
+                         factor_rows: int = 8,
+                         ring_size: int = 8,
+                         labels: Optional[Iterable[str]] = None,
+                         cost_log=None,
+                         progress=None) -> List[HarvestedProgram]:
+    """Lower every entry-point program (optionally restricted to
+    ``labels``) and return the harvest. ``progress`` is an optional
+    ``callable(label, seconds)`` hook for CLIs — a full sweep is ~20
+    compiles and minutes of XLA-CPU time, silence reads as a hang."""
+    wanted = set(labels) if labels is not None else None
+    out: List[HarvestedProgram] = []
+    for label, fn, args in entry_point_programs(
+            dtype=dtype, factor_rows=factor_rows, ring_size=ring_size):
+        if wanted is not None and label not in wanted:
+            continue
+        hp = lower_program(label, fn, args, cost_log=cost_log)
+        if progress is not None:
+            progress(label, hp.compile_s)
+        out.append(hp)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# GC204 — bucket-ladder padding cells
+# ---------------------------------------------------------------------------
+
+def _qp_lane_bytes(n: int, m: int, dtype=np.float32) -> int:
+    """Input bytes of ONE lane of the batched QP at shape (n, m) — from
+    the solver's own ``batch_shape_struct`` leaves, so the arithmetic
+    cannot fork from what the serve plane actually allocates."""
+    import jax
+
+    from porqua_tpu.qp.solve import batch_shape_struct
+
+    struct = batch_shape_struct(1, n, m, dtype=dtype)
+    return int(sum(int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
+                   for leaf in jax.tree.leaves(struct)))
+
+
+def bucket_padding_cells(n_rungs: Optional[Sequence[int]] = None,
+                         m_rungs: Optional[Sequence[int]] = None,
+                         dtype=np.float32) -> List[Dict[str, Any]]:
+    """The worst-case dead-lane byte share per bucket of the serving
+    ladder: for each ``(n, m)`` rung pair, the natural shape that pads
+    worst is one past the previous rung on both axes. These are the
+    GC204 cells: the committed baseline records each cell's share, and
+    a ladder change that worsens a cell past its budget is a finding."""
+    from porqua_tpu.serve.bucketing import DEFAULT_M_RUNGS, DEFAULT_N_RUNGS
+
+    n_rungs = tuple(n_rungs or DEFAULT_N_RUNGS)
+    m_rungs = tuple(m_rungs or DEFAULT_M_RUNGS)
+    cells: List[Dict[str, Any]] = []
+    for i, n in enumerate(n_rungs):
+        nat_n = (n_rungs[i - 1] + 1) if i else 1
+        for j, m in enumerate(m_rungs):
+            nat_m = (m_rungs[j - 1] + 1) if j else 1
+            padded = _qp_lane_bytes(n, m, dtype=dtype)
+            natural = _qp_lane_bytes(nat_n, nat_m, dtype=dtype)
+            cells.append({
+                "bucket": f"{n}x{m}",
+                "natural": f"{nat_n}x{nat_m}",
+                "padded_bytes": padded,
+                "natural_bytes": natural,
+                "share": 1.0 - natural / padded,
+            })
+    return cells
+
+
+def padding_findings(cells: Iterable[Dict[str, Any]],
+                     budgets: Optional[Dict[str, float]] = None,
+                     default_budget: float = 0.25) -> List[Finding]:
+    """GC204 over ladder cells: each cell's worst-case share vs its
+    per-bucket budget (``budgets[bucket]``, falling back to the
+    default). Program anchor is ``bucket_ladder[<bucket>]``."""
+    findings: List[Finding] = []
+    budgets = budgets or {}
+    for idx, cell in enumerate(cells):
+        bucket = cell["bucket"]
+        findings += hlolint.check_padding_waste(
+            f"bucket_ladder[{bucket}]",
+            natural_bytes=cell["natural_bytes"],
+            padded_bytes=cell["padded_bytes"],
+            budget=float(budgets.get(bucket, default_budget)),
+            bucket=bucket, line=idx + 1)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# lint harness + baseline
+# ---------------------------------------------------------------------------
+
+def lint_harvest(programs: Sequence[HarvestedProgram],
+                 baseline: Optional[Dict[str, Any]] = None,
+                 config: Optional[hlolint.LintConfig] = None,
+                 rules: Optional[Iterable[str]] = None,
+                 include_padding: bool = True,
+                 stats_out: Optional[Dict[str, Any]] = None,
+                 ) -> List[Finding]:
+    """Run GC201-GC206 over a harvest. The committed baseline supplies
+    the per-program GC205 peak budgets, the per-bucket GC204 budgets,
+    and the suppression table; without one, GC205 has no bounds to
+    check and GC204 falls back to each cell's recorded-share-free
+    default budget. ``stats_out`` (when given) receives
+    ``hlo_programs`` / ``hlo_suppressions_by_rule`` for
+    ``run_checks.py --stats``."""
+    base_programs = (baseline or {}).get("programs", {})
+    base_padding = (baseline or {}).get("padding", {})
+    findings: List[Finding] = []
+    for hp in programs:
+        module = hp.parse()
+        entry = base_programs.get(hp.label, {})
+        findings += hlolint.lint_module(
+            module, hp.label, config=config,
+            peak_bytes=hp.peak_bytes,
+            peak_budget=entry.get("peak_budget"),
+            rules=rules)
+    selected = set(rules) if rules is not None else set(hlolint.HLO_RULES)
+    if include_padding and "GC204" in selected:
+        findings += padding_findings(
+            bucket_padding_cells(),
+            budgets=base_padding.get("budgets"),
+            default_budget=float(base_padding.get("default_budget", 0.25)))
+    findings, suppressed = hlolint.apply_suppressions(
+        findings, (baseline or {}).get("suppressions", ()))
+    if stats_out is not None:
+        stats_out["hlo_programs"] = len(programs)
+        stats_out["hlo_suppressions_by_rule"] = suppressed
+    return findings
+
+
+def load_baseline(path: Optional[str] = None) -> Optional[Dict[str, Any]]:
+    """Read the committed baseline; ``None`` when absent (a fresh tree
+    that has not built one yet — callers degrade, not crash)."""
+    path = path or DEFAULT_BASELINE_PATH
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def build_baseline(programs: Sequence[HarvestedProgram],
+                   config: Optional[hlolint.LintConfig] = None,
+                   padding_margin: float = 0.02) -> Dict[str, Any]:
+    """The committed artifact: per-program fingerprints + measured
+    cost + GC205 peak budgets (measured peak x headroom), the GC204
+    ladder cells with per-bucket budgets (current worst-case share +
+    margin — a ladder change that worsens a cell fails), the finding
+    counts at build time (the bench gate's regression floor), and the
+    — empty — suppression table."""
+    cfg = config or hlolint.LintConfig()
+    entries: Dict[str, Any] = {}
+    for hp in programs:
+        module = hp.parse()
+        found = hlolint.lint_module(module, hp.label, config=cfg,
+                                    peak_bytes=hp.peak_bytes,
+                                    peak_budget=None)
+        by_rule: Dict[str, int] = {}
+        for f in found:
+            by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+        peak = hp.peak_bytes
+        entries[hp.label] = {
+            "fingerprint": hp.fingerprint,
+            "flops": hp.flops,
+            "bytes_accessed": hp.bytes_accessed,
+            "peak_bytes": peak,
+            "peak_budget": (None if peak is None
+                            else float(int(peak * PEAK_HEADROOM))),
+            "hlo_lines": hp.hlo_text.count("\n") + 1,
+            "compile_s": round(hp.compile_s, 3),
+            "findings_by_rule": by_rule,
+        }
+    cells = bucket_padding_cells()
+    budgets = {c["bucket"]: round(c["share"] + padding_margin, 4)
+               for c in cells}
+    return {
+        "schema": BASELINE_SCHEMA_VERSION,
+        "built_t": time.time(),
+        "dtype": "float32",
+        "config": dataclasses.asdict(cfg),
+        "programs": entries,
+        "padding": {"default_budget": cfg.padding_budget,
+                    "budgets": budgets, "cells": cells},
+        "suppressions": [],
+    }
+
+
+def compare_fingerprints(baseline: Dict[str, Any],
+                         programs: Sequence[HarvestedProgram],
+                         ) -> Dict[str, List[str]]:
+    """Diff a fresh harvest's fingerprints against the baseline's.
+    ``flipped`` names programs that re-lowered differently on an
+    unchanged source tree (an XLA/runtime change, or a silent program
+    change); ``missing`` are baseline programs the harvest lost
+    (coverage regression); ``new`` are programs the baseline predates."""
+    base = baseline.get("programs", {})
+    fresh = {hp.label: hp.fingerprint for hp in programs}
+    flipped = sorted(
+        label for label, fp in fresh.items()
+        if label in base and base[label].get("fingerprint")
+        and fp and fp != base[label]["fingerprint"])
+    missing = sorted(set(base) - set(fresh))
+    new = sorted(set(fresh) - set(base))
+    return {"flipped": flipped, "missing": missing, "new": new}
+
+
+def bench_hlo_part(baseline: Optional[Dict[str, Any]] = None,
+                   programs: Optional[Sequence[HarvestedProgram]] = None,
+                   dtype=np.float32) -> Dict[str, Any]:
+    """The ``config_hlo`` bench part: a fresh harvest linted against
+    the committed baseline, summarized to what the gate's hlo rule
+    class holds — program coverage, total and per-program-max finding
+    counts, fingerprint flips, and the top fusion target's measured
+    bytes (the number a fusion win must move and a regression must not
+    grow)."""
+    if baseline is None:
+        baseline = load_baseline()
+    if programs is None:
+        programs = harvest_entry_points(dtype=dtype)
+    findings = lint_harvest(programs, baseline=baseline)
+    by_program: Dict[str, int] = {}
+    for f in findings:
+        prog = hlolint.path_program(f.path) or f.path
+        by_program[prog] = by_program.get(prog, 0) + 1
+    by_rule: Dict[str, int] = {}
+    for f in findings:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    top = max(programs, key=lambda hp: hp.bytes_accessed or 0.0,
+              default=None)
+    flips = (compare_fingerprints(baseline, programs)["flipped"]
+             if baseline else [])
+    part: Dict[str, Any] = {
+        "programs": len(programs),
+        "findings_total": len(findings),
+        "findings_by_rule": by_rule,
+        "findings_by_program": by_program,
+        "findings_max_per_program": max(by_program.values(), default=0),
+        "fingerprint_flips": len(flips),
+        "flipped_programs": flips,
+        "compile_s_total": round(sum(hp.compile_s for hp in programs), 3),
+    }
+    if top is not None:
+        part["top_target"] = top.label
+        part["top_target_bytes"] = top.bytes_accessed
+    return part

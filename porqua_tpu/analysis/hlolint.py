@@ -1,0 +1,695 @@
+"""hlolint: post-lowering static analysis over optimized HLO text.
+
+graftcheck's jaxpr contracts (GC101-GC110, :mod:`.contracts`) stop at
+the trace: what XLA actually *emits* — fusions, layouts, temporaries,
+padding, post-lowering dtype changes — is invisible to them, so a
+refactor or an XLA upgrade can silently unfuse the segment stepper and
+the first evidence is a burned chip window. This module closes that
+gap: it parses the optimized-HLO ``as_text()`` of a compiled
+executable (harvested by :mod:`.hlo` from every
+``contracts.check_entry_points`` program) into a light
+instruction/fusion graph and runs typed rules over it:
+
+* **GC201 fusion miss** — an unfused elementwise/reduce chain whose
+  materialized intermediate clears a ridge-point byte threshold (the
+  same measured-bytes axis ``roofline_report`` ranks fusion candidates
+  on, so the lint and the verdict agree on targets).
+* **GC202 redundant materialization** — the same subcomputation
+  (canonicalized fusion body, or a duplicate dot/convolution with
+  identical operands) emitted >= 2x in one module: the Gram build or a
+  residual norm computed twice.
+* **GC203 layout churn** — chained data-movement pairs
+  (copy/transpose/bitcast-convert feeding each other): the same bytes
+  moved twice for layout's sake on the hot path.
+* **GC204 padding waste** — a bucket-ladder padded shape whose
+  dead-lane byte share exceeds its per-bucket budget.
+* **GC205 temporary-peak budget** — ``memory_analysis()`` peak bytes
+  over the committed per-program bound.
+* **GC206 post-lowering dtype drift** — f64/c128 (or an explicit
+  widening convert) emitted by XLA inside a program whose jaxpr was
+  f32-clean: exactly what GC101 cannot see after lowering.
+
+Findings reuse :class:`porqua_tpu.analysis.lint.Finding`; the ``path``
+is the virtual ``<hlo:PROGRAM>`` anchor (there is no source file — the
+line number indexes the harvested HLO text, which
+``scripts/hlolint_report.py`` can print around a finding). Rule ids
+live in ``lint.RULE_DOCS`` next to the AST and jaxpr rules so
+``run_checks.py --select`` / ``--stats`` treat all three planes
+uniformly. Suppressions are per-(program, rule) entries in the
+committed baseline artifact (``HLO_BASELINE.json`` — see
+:func:`apply_suppressions`), not source comments: HLO has no source
+lines to annotate, and the baseline file is already the per-program
+contract surface. The shipped baseline carries ZERO suppressions —
+same bar as the AST plane.
+
+Pure stdlib on purpose (no jax/numpy): the parser and every rule run
+on captured text, so the seeded-violation tests and the CI selftest
+(``hlolint_report.py --selftest``) cost no backend compile.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import (Any, Dict, Iterable, List, Mapping, Optional,
+                    Sequence, Set, Tuple)
+
+from porqua_tpu.analysis.lint import Finding
+
+__all__ = [
+    "HLO_RULES",
+    "HloComputation",
+    "HloInstruction",
+    "HloModule",
+    "LintConfig",
+    "apply_suppressions",
+    "check_dtype_drift",
+    "check_fusion_miss",
+    "check_layout_churn",
+    "check_padding_waste",
+    "check_redundant_materialization",
+    "check_temp_peak",
+    "hlo_path",
+    "lint_module",
+    "parse_hlo",
+    "path_program",
+    "shape_bytes",
+]
+
+#: The post-lowering rule ids this module owns (documented in
+#: ``lint.RULE_DOCS``; ``run_checks.py --select`` matches against it).
+HLO_RULES = ("GC201", "GC202", "GC203", "GC204", "GC205", "GC206")
+
+_DTYPE_BYTES = {
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+    "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3b11fnuz": 1,
+}
+
+_ARRAY_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+
+
+def shape_bytes(shape: str) -> int:
+    """Total buffer bytes of an HLO shape string — a plain array
+    (``f32[4,16]{1,0}``), a scalar (``f32[]``), or a tuple (sum of the
+    elements). Layout braces are ignored; unknown dtypes count 4."""
+    total = 0
+    for dtype, dims in _ARRAY_RE.findall(shape):
+        count = 1
+        for d in dims.split(","):
+            if d:
+                count *= int(d)
+        total += count * _DTYPE_BYTES.get(dtype, 4)
+    return total
+
+
+def shape_dtypes(shape: str) -> Set[str]:
+    """The element dtypes an HLO shape string mentions."""
+    return {dtype for dtype, _ in _ARRAY_RE.findall(shape)}
+
+
+@dataclasses.dataclass
+class HloInstruction:
+    """One parsed HLO instruction line."""
+
+    name: str             #: SSA name without the leading ``%``
+    shape: str            #: result shape string (layout included)
+    opcode: str
+    operands: Tuple[str, ...]  #: referenced ``%names`` in the operand list
+    line: int             #: 1-based line in the module text
+    attrs: str            #: raw text after the operand list
+    is_root: bool = False
+
+    @property
+    def bytes(self) -> int:
+        return shape_bytes(self.shape)
+
+    @property
+    def called(self) -> Tuple[str, ...]:
+        """Computations this instruction calls (fusion bodies, reducer
+        lambdas, while bodies/conditions, conditional branches)."""
+        return tuple(m.group(2) for m in _CALL_RE.finditer(self.attrs))
+
+
+@dataclasses.dataclass
+class HloComputation:
+    """One computation block: the ENTRY, a fusion body, a while
+    body/condition, or a reducer lambda."""
+
+    name: str
+    line: int                     #: header line number
+    params: List[Tuple[str, str]]  #: (name, shape) in signature order
+    instructions: List[HloInstruction]
+    is_entry: bool = False
+
+    def __post_init__(self) -> None:
+        self.by_name: Dict[str, HloInstruction] = {
+            i.name: i for i in self.instructions}
+
+    @property
+    def root(self) -> Optional[HloInstruction]:
+        for i in self.instructions:
+            if i.is_root:
+                return i
+        return self.instructions[-1] if self.instructions else None
+
+
+@dataclasses.dataclass
+class HloModule:
+    """A parsed HLO module: computations by name plus the raw text."""
+
+    name: str
+    text: str
+    computations: Dict[str, HloComputation]
+    entry: Optional[HloComputation]
+
+    def fusion_bodies(self) -> Dict[str, HloComputation]:
+        """Computations reached through a ``fusion`` op's ``calls=`` —
+        the subcomputations XLA actually fused (reducer lambdas and
+        while bodies are *not* fusion bodies)."""
+        called: Dict[str, HloComputation] = {}
+        for comp in self.computations.values():
+            for instr in comp.instructions:
+                if instr.opcode == "fusion":
+                    for target in instr.called:
+                        if target in self.computations:
+                            called[target] = self.computations[target]
+        return called
+
+    def scheduled_computations(self) -> List[HloComputation]:
+        """Computations whose instructions execute as emitted (ENTRY +
+        while bodies + conditional branches) — everything except fusion
+        bodies (fused away) and reducer lambdas (per-element)."""
+        fused = set(self.fusion_bodies())
+        small = {t for comp in self.computations.values()
+                 for instr in comp.instructions
+                 if instr.opcode in ("reduce", "reduce-window", "scatter",
+                                     "sort", "map", "all-reduce",
+                                     "select-and-scatter")
+                 for t in instr.called}
+        return [c for c in self.computations.values()
+                if c.name not in fused and c.name not in small]
+
+
+_MODULE_RE = re.compile(r"^HloModule\s+([\w.\-]+)")
+_HEADER_RE = re.compile(
+    r"^\s*(ENTRY\s+)?%?([\w.\-]+)\s*\((.*)\)\s*->\s*.+?\s*\{\s*$")
+_OPERAND_NAME_RE = re.compile(r"%([\w.\-]+)")
+_CALL_RE = re.compile(
+    r"(calls|to_apply|body|condition|branch_computations)="
+    r"\{?%?([\w.\-]+)")
+
+
+def _split_shape(rest: str) -> Tuple[str, str]:
+    """Split ``rest`` into (result shape, remainder) — the shape is
+    either a parenthesized tuple or a single space-free token."""
+    if rest.startswith("("):
+        depth = 0
+        for i, ch in enumerate(rest):
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+                if depth == 0:
+                    return rest[:i + 1], rest[i + 1:].lstrip()
+        return rest, ""
+    cut = rest.find(" ")
+    if cut < 0:
+        return rest, ""
+    return rest[:cut], rest[cut + 1:].lstrip()
+
+
+def _split_operands(body: str) -> Tuple[str, str]:
+    """Split ``opcode(...)...`` tail after the opening paren into
+    (operand segment, attrs) by matching the close paren."""
+    depth = 1
+    for i, ch in enumerate(body):
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+            if depth == 0:
+                return body[:i], body[i + 1:].lstrip(", ").strip()
+    return body, ""
+
+
+def _parse_params(seg: str) -> List[Tuple[str, str]]:
+    """Signature parameters ``name: shape`` split on top-level commas."""
+    params: List[Tuple[str, str]] = []
+    depth = 0
+    start = 0
+    parts: List[str] = []
+    for i, ch in enumerate(seg):
+        if ch in "([{":
+            depth += 1
+        elif ch in ")]}":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            parts.append(seg[start:i])
+            start = i + 1
+    if seg[start:].strip():
+        parts.append(seg[start:])
+    for part in parts:
+        if ":" not in part:
+            continue
+        name, shape = part.split(":", 1)
+        params.append((name.strip().lstrip("%"), shape.strip()))
+    return params
+
+
+def parse_hlo(text: str) -> HloModule:
+    """Parse optimized-HLO module text into the light graph the rules
+    walk. Tolerant by construction: lines that match neither a
+    computation header nor an instruction are skipped, so schedule
+    annotations, buffer-assignment dumps, and future decoration do not
+    break the lint."""
+    module_name = ""
+    computations: Dict[str, HloComputation] = {}
+    entry: Optional[HloComputation] = None
+
+    current: Optional[HloComputation] = None
+    for lineno, line in enumerate(text.splitlines(), start=1):
+        stripped = line.strip()
+        if not stripped:
+            continue
+        m = _MODULE_RE.match(stripped)
+        if m:
+            module_name = m.group(1)
+            continue
+        if current is None:
+            h = _HEADER_RE.match(line)
+            if h and "=" not in line.split("(")[0]:
+                current = HloComputation(
+                    name=h.group(2), line=lineno,
+                    params=_parse_params(h.group(3)),
+                    instructions=[], is_entry=bool(h.group(1)))
+            continue
+        if stripped == "}":
+            current.by_name = {i.name: i for i in current.instructions}
+            computations[current.name] = current
+            if current.is_entry:
+                entry = current
+            current = None
+            continue
+        root = stripped.startswith("ROOT ")
+        body = stripped[5:] if root else stripped
+        if not body.startswith("%") or "=" not in body:
+            continue
+        name, _, rest = body.partition("=")
+        name = name.strip().lstrip("%")
+        rest = rest.strip()
+        shape, rest = _split_shape(rest)
+        paren = rest.find("(")
+        if paren < 0:
+            continue
+        opcode = rest[:paren].strip()
+        operand_seg, attrs = _split_operands(rest[paren + 1:])
+        current.instructions.append(HloInstruction(
+            name=name, shape=shape, opcode=opcode,
+            operands=tuple(_OPERAND_NAME_RE.findall(operand_seg)),
+            line=lineno, attrs=attrs, is_root=root))
+    if current is not None:  # unterminated block: keep what parsed
+        current.by_name = {i.name: i for i in current.instructions}
+        computations[current.name] = current
+        if current.is_entry:
+            entry = current
+
+    return HloModule(name=module_name, text=text,
+                     computations=computations, entry=entry)
+
+
+# ---------------------------------------------------------------------------
+# finding anchors
+# ---------------------------------------------------------------------------
+
+_HLO_PATH_RE = re.compile(r"^<hlo:(.+)>$")
+
+
+def hlo_path(program: str) -> str:
+    """The virtual path findings on ``program``'s HLO anchor to."""
+    return f"<hlo:{program}>"
+
+
+def path_program(path: str) -> Optional[str]:
+    """Inverse of :func:`hlo_path`; ``None`` for ordinary file paths."""
+    m = _HLO_PATH_RE.match(path)
+    return m.group(1) if m else None
+
+
+@dataclasses.dataclass
+class LintConfig:
+    """Thresholds the rules judge against. The defaults are the
+    committed-tree contract (HLO_BASELINE.json records the config it
+    was built with); the report CLI can override per run."""
+
+    #: GC201: minimum bytes a materialized intermediate must reach to
+    #: count as a fusion miss — the ridge-point threshold. At the
+    #: harvest shapes everything XLA leaves unfused is small; a real
+    #: miss on a production shape clears 64 KiB easily.
+    fusion_miss_min_bytes: float = 65536.0
+    #: GC203: minimum bytes moved twice before churn is worth a finding.
+    churn_min_bytes: float = 16384.0
+    #: GC202: fusion bodies smaller than this many ops are ignored
+    #: (XLA legitimately duplicates tiny ones instead of materializing).
+    dup_min_ops: int = 4
+    #: GC202: minimum bytes a duplicated result must materialize before
+    #: the pair is a finding rather than an XLA-CSE rounding error.
+    dup_min_bytes: float = 4096.0
+    #: GC204: default dead-lane byte share budget per bucket.
+    padding_budget: float = 0.25
+    #: GC206: the widest float the program is allowed to emit.
+    expect_float: str = "f32"
+
+
+# ---------------------------------------------------------------------------
+# GC201 — fusion miss
+# ---------------------------------------------------------------------------
+
+_ELEMENTWISE = {
+    "abs", "add", "and", "atan2", "ceil", "clamp", "compare", "cosine",
+    "divide", "exponential", "exponential-minus-one", "floor", "log",
+    "log-plus-one", "maximum", "minimum", "multiply", "negate", "not",
+    "or", "power", "remainder", "round-nearest-afz", "rsqrt", "select",
+    "sign", "sine", "sqrt", "subtract", "tanh", "xor",
+}
+_REDUCERS = {"reduce", "reduce-window"}
+
+
+def check_fusion_miss(module: HloModule, program: str,
+                      min_bytes: float = 65536.0) -> List[Finding]:
+    """GC201: an elementwise producer feeding an elementwise/reduce
+    consumer as two *scheduled* instructions — the intermediate is
+    materialized to memory where a fusion would have kept it in
+    registers. Only intermediates at least ``min_bytes`` wide count
+    (the ridge-point threshold: below it the roundtrip is latency
+    noise, above it the program is provably bandwidth-bound on bytes
+    a fusion removes). Findings are ranked widest-first, the same
+    measured-bytes ordering ``roofline_report`` ranks its fusion
+    candidates by."""
+    ranked: List[Tuple[int, Finding]] = []
+    for comp in module.scheduled_computations():
+        flagged: Set[str] = set()
+        for instr in comp.instructions:
+            if instr.opcode not in (_ELEMENTWISE | _REDUCERS):
+                continue
+            for op_name in instr.operands:
+                prod = comp.by_name.get(op_name)
+                if prod is None or prod.name in flagged:
+                    continue
+                if prod.opcode not in _ELEMENTWISE:
+                    continue
+                nbytes = prod.bytes
+                if nbytes < min_bytes:
+                    continue
+                flagged.add(prod.name)
+                ranked.append((nbytes, Finding(
+                    "GC201", hlo_path(program), prod.line, 1,
+                    f"fusion miss: {prod.opcode} -> {instr.opcode} left "
+                    f"unfused in {comp.name}; the {prod.shape} "
+                    f"intermediate materializes {nbytes} B per dispatch "
+                    f"(ridge threshold {int(min_bytes)} B)")))
+    ranked.sort(key=lambda pair: (-pair[0], pair[1].line))
+    return [f for _, f in ranked]
+
+
+# ---------------------------------------------------------------------------
+# GC202 — redundant materialization
+# ---------------------------------------------------------------------------
+
+def _canonical_body(comp: HloComputation) -> Tuple:
+    """A rename-invariant signature of a computation body: opcodes,
+    shapes, and operand references rewritten to local positions."""
+    local = {name: f"i{idx}" for idx, name in
+             enumerate(i.name for i in comp.instructions)}
+    for idx, (pname, _) in enumerate(comp.params):
+        local.setdefault(pname, f"p{idx}")
+    rows = []
+    for instr in comp.instructions:
+        rows.append((instr.opcode, instr.shape,
+                     tuple(local.get(op, "?") for op in instr.operands)))
+    return (tuple(s for _, s in comp.params), tuple(rows))
+
+
+def check_redundant_materialization(module: HloModule, program: str,
+                                    min_ops: int = 4,
+                                    min_bytes: float = 4096.0,
+                                    ) -> List[Finding]:
+    """GC202: the same subcomputation materialized >= 2x in one module
+    — two fusion *call sites* whose bodies are canonically identical
+    AND whose operands are identical (the Gram build or a residual
+    norm computed twice instead of reused), or a duplicated expensive
+    op (dot/convolution with identical operands and shape surviving in
+    one computation). Cloned fusion bodies alone are NOT findings: XLA
+    clones one body per call site by design (unrolled segment steps
+    each call their own copy with different state), and only an
+    identical-operand pair recomputes anything. Duplicates whose
+    result is under ``min_bytes`` are noise, not bandwidth (XLA's own
+    CSE misses the occasional tiny constant-fed pair — see the README
+    triage table)."""
+    findings: List[Finding] = []
+
+    bodies = module.fusion_bodies()
+    body_sig: Dict[str, Tuple] = {}
+    for name, comp in bodies.items():
+        if len(comp.instructions) >= min_ops:
+            body_sig[name] = _canonical_body(comp)
+
+    for comp in module.scheduled_computations():
+        seen_calls: Dict[Tuple, HloInstruction] = {}
+        for instr in comp.instructions:
+            if instr.opcode != "fusion":
+                continue
+            sigs = tuple(body_sig.get(t) for t in instr.called
+                         if t in bodies)
+            if not sigs or any(s is None for s in sigs):
+                continue
+            key = (sigs, instr.shape, instr.operands)
+            prev = seen_calls.get(key)
+            if prev is None:
+                seen_calls[key] = instr
+                continue
+            if instr.bytes < min_bytes:
+                continue
+            body = next(t for t in instr.called if t in bodies)
+            findings.append(Finding(
+                "GC202", hlo_path(program), instr.line, 1,
+                f"redundant materialization: fusion {instr.name} "
+                f"({body}, {instr.shape}) in {comp.name} recomputes "
+                f"{prev.name} (line {prev.line}) over identical "
+                "operands — the same subcomputation is emitted and "
+                "materialized twice in one module"))
+
+    for comp in module.scheduled_computations():
+        seen: Dict[Tuple, HloInstruction] = {}
+        for instr in comp.instructions:
+            if instr.opcode not in ("dot", "convolution"):
+                continue
+            key = (instr.opcode, instr.shape, instr.operands)
+            prev = seen.get(key)
+            if prev is None:
+                seen[key] = instr
+                continue
+            findings.append(Finding(
+                "GC202", hlo_path(program), instr.line, 1,
+                f"redundant materialization: {instr.opcode} "
+                f"{instr.shape} over {', '.join(instr.operands)} in "
+                f"{comp.name} repeats {prev.name} (line {prev.line}) "
+                "with identical operands — CSE left the contraction "
+                "computed twice"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# GC203 — layout churn
+# ---------------------------------------------------------------------------
+
+_MOVERS = {"copy", "transpose", "bitcast-convert"}
+
+
+def check_layout_churn(module: HloModule, program: str,
+                       min_bytes: float = 16384.0) -> List[Finding]:
+    """GC203: a copy/transpose/bitcast-convert whose operand is itself
+    one — the same buffer moved twice for layout's sake. Plain
+    ``bitcast`` is exempt (metadata-only, no data movement); pairs
+    under ``min_bytes`` are latency noise, not bandwidth."""
+    findings: List[Finding] = []
+    for comp in module.scheduled_computations():
+        for instr in comp.instructions:
+            if instr.opcode not in _MOVERS:
+                continue
+            for op_name in instr.operands:
+                prod = comp.by_name.get(op_name)
+                if prod is None or prod.opcode not in _MOVERS:
+                    continue
+                nbytes = max(instr.bytes, prod.bytes)
+                if nbytes < min_bytes:
+                    continue
+                findings.append(Finding(
+                    "GC203", hlo_path(program), instr.line, 1,
+                    f"layout churn: {prod.opcode} (line {prod.line}) -> "
+                    f"{instr.opcode} in {comp.name} moves {nbytes} B "
+                    "twice for layout — fold the transposition into "
+                    "the producer or pin the layout"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# GC204 — padding waste
+# ---------------------------------------------------------------------------
+
+def check_padding_waste(program: str,
+                        natural_bytes: float,
+                        padded_bytes: Optional[float] = None,
+                        budget: float = 0.25,
+                        module: Optional[HloModule] = None,
+                        bucket: Optional[str] = None,
+                        line: int = 1) -> List[Finding]:
+    """GC204: the dead-lane byte share of a bucket-padded program —
+    ``1 - natural/padded`` — exceeds its per-bucket budget. The padded
+    bytes come from the lowered entry signature when a ``module`` is
+    given (the shapes XLA actually allocated), or are passed directly
+    (the bucket-ladder arithmetic ``hlo.bucket_padding_cells``
+    computes)."""
+    if module is not None and module.entry is not None:
+        padded_bytes = float(sum(shape_bytes(s)
+                                 for _, s in module.entry.params))
+        line = module.entry.line
+    if not padded_bytes or natural_bytes is None:
+        return []
+    share = 1.0 - float(natural_bytes) / float(padded_bytes)
+    if share <= budget:
+        return []
+    where = f" (bucket {bucket})" if bucket else ""
+    return [Finding(
+        "GC204", hlo_path(program), line, 1,
+        f"padding waste{where}: dead-lane byte share {share:.3f} over "
+        f"budget {budget:.3f} — {int(padded_bytes - natural_bytes)} of "
+        f"{int(padded_bytes)} padded input bytes are dead lanes")]
+
+
+# ---------------------------------------------------------------------------
+# GC205 — temporary-peak budget
+# ---------------------------------------------------------------------------
+
+def check_temp_peak(program: str,
+                    peak_bytes: Optional[float],
+                    budget_bytes: Optional[float],
+                    line: int = 1) -> List[Finding]:
+    """GC205: ``memory_analysis()`` peak bytes over the committed
+    per-program bound. No bound (a program the baseline has not seen)
+    or no measurement (a backend that refuses the analysis) checks
+    nothing — absence is handled by the coverage rules in bench_gate,
+    not by a fake pass here."""
+    if peak_bytes is None or budget_bytes is None:
+        return []
+    if float(peak_bytes) <= float(budget_bytes):
+        return []
+    return [Finding(
+        "GC205", hlo_path(program), line, 1,
+        f"temporary-peak budget: memory_analysis peak {int(peak_bytes)} B "
+        f"exceeds the committed bound {int(budget_bytes)} B — a bigger "
+        "live range (lost fusion, new temporary) lands here before it "
+        "OOMs a chip window")]
+
+
+# ---------------------------------------------------------------------------
+# GC206 — post-lowering dtype drift
+# ---------------------------------------------------------------------------
+
+_WIDER_THAN = {
+    "f16": {"f32", "f64", "c64", "c128"},
+    "bf16": {"f32", "f64", "c64", "c128"},
+    "f32": {"f64", "c128"},
+    "f64": set(),
+}
+
+
+def check_dtype_drift(module: HloModule, program: str,
+                      expect_float: str = "f32") -> List[Finding]:
+    """GC206: an instruction whose result is wider than the program's
+    float policy (f64/c128 in an f32 program) after lowering — the
+    drift GC101 cannot see because it appears in XLA's output, not the
+    jaxpr. One finding per (computation, opcode): the first occurrence
+    anchors it, the rest are the same root cause."""
+    wide = _WIDER_THAN.get(expect_float, {"f64", "c128"})
+    if not wide:
+        return []
+    findings: List[Finding] = []
+    for comp in module.computations.values():
+        seen: Set[str] = set()
+        for instr in comp.instructions:
+            hit = shape_dtypes(instr.shape) & wide
+            if not hit or instr.opcode in seen:
+                continue
+            seen.add(instr.opcode)
+            findings.append(Finding(
+                "GC206", hlo_path(program), instr.line, 1,
+                f"post-lowering dtype drift: {instr.opcode} emits "
+                f"{'/'.join(sorted(hit))} in {comp.name} of a "
+                f"{expect_float} program — widening XLA introduced "
+                "after the jaxpr (GC101) was checked"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# orchestration
+# ---------------------------------------------------------------------------
+
+def lint_module(module: HloModule,
+                program: str,
+                config: Optional[LintConfig] = None,
+                peak_bytes: Optional[float] = None,
+                peak_budget: Optional[float] = None,
+                rules: Optional[Iterable[str]] = None) -> List[Finding]:
+    """Run every module-scoped rule (GC201/202/203/206 — plus GC205
+    when a peak and its budget are supplied) over one parsed program.
+    GC204 is ladder-scoped, not module-scoped: drive
+    :func:`check_padding_waste` from the bucket cells directly."""
+    cfg = config or LintConfig()
+    selected = set(rules) if rules is not None else set(HLO_RULES)
+    findings: List[Finding] = []
+    if "GC201" in selected:
+        findings += check_fusion_miss(module, program,
+                                      cfg.fusion_miss_min_bytes)
+    if "GC202" in selected:
+        findings += check_redundant_materialization(module, program,
+                                                    cfg.dup_min_ops,
+                                                    cfg.dup_min_bytes)
+    if "GC203" in selected:
+        findings += check_layout_churn(module, program,
+                                       cfg.churn_min_bytes)
+    if "GC205" in selected:
+        findings += check_temp_peak(program, peak_bytes, peak_budget)
+    if "GC206" in selected:
+        findings += check_dtype_drift(module, program, cfg.expect_float)
+    return findings
+
+
+def apply_suppressions(
+        findings: Sequence[Finding],
+        suppressions: Iterable[Mapping[str, Any]],
+) -> Tuple[List[Finding], Dict[str, int]]:
+    """Filter findings against baseline suppression entries
+    (``{"program": <label or "*">, "rule": "GC2xx", "reason": ...}``)
+    and count what was suppressed per rule — the counts feed
+    ``run_checks.py --stats`` so HLO suppression creep is as visible
+    as source-comment creep. Entries without a reason are ignored: an
+    unexplained suppression is a finding, not a policy."""
+    table: Set[Tuple[str, str]] = set()
+    for entry in suppressions:
+        rule = str(entry.get("rule", ""))
+        prog = str(entry.get("program", "*"))
+        if rule and entry.get("reason"):
+            table.add((prog, rule))
+    kept: List[Finding] = []
+    counts: Dict[str, int] = {}
+    for f in findings:
+        prog = path_program(f.path) or f.path
+        if (prog, f.rule) in table or ("*", f.rule) in table:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        else:
+            kept.append(f)
+    return kept, counts

@@ -101,6 +101,22 @@ RULE_DOCS = {
     "GC108": "fleet federation plane perturbs a traced program",
     "GC109": "tenant plane perturbs a traced program",
     "GC110": "solver routing perturbs a traced program",
+    # Post-lowering HLO rules (porqua_tpu/analysis/hlolint.py): run
+    # over the optimized HLO harvested from every entry-point program
+    # (analysis/hlo.py), not over source text — what XLA emitted, not
+    # what we traced.
+    "GC201": "fusion miss: unfused elementwise/reduce chain past the "
+             "ridge-point byte threshold",
+    "GC202": "redundant materialization: same subcomputation emitted "
+             ">=2x in one HLO module",
+    "GC203": "layout churn: chained copy/transpose/bitcast-convert "
+             "data movement",
+    "GC204": "padding waste: bucket dead-lane byte share over the "
+             "per-bucket budget",
+    "GC205": "temporary-peak budget: memory_analysis peak over the "
+             "committed per-program bound",
+    "GC206": "post-lowering dtype drift: f64/widening emitted by XLA "
+             "in an f32 program",
 }
 
 _CONTRACTIONS = {"dot", "einsum", "matmul", "tensordot", "inner", "vdot"}

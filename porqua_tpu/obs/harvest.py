@@ -509,6 +509,15 @@ def aggregate(records: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
                        if r.get("solve_s") is not None]
                 if lat:
                     entry["solve_s_mean"] = float(np.mean(lat))
+                # Routed decisions: dispatches the router actually sent
+                # to this backend, i.e. everything that is not a shadow
+                # re-solve (source "serve.shadow" / shadow_of set). The
+                # count harvest_report's solver table shows next to the
+                # win column — evidence volume behind each cell.
+                entry["routed"] = sum(
+                    1 for r in srecs
+                    if not r.get("shadow_of")
+                    and str(r.get("source", "")) != "serve.shadow")
                 by_solver[sv] = entry
             row["by_solver"] = by_solver
         table.append(row)

@@ -76,6 +76,11 @@ BENCH_METRICS = (
     "config_compaction.recompiles_in_measured_solve",
     "config_compaction.te_drift",
     "config_compaction.lane_segments_reduction",
+    "config_hlo.programs",
+    "config_hlo.findings_total",
+    "config_hlo.findings_max_per_program",
+    "config_hlo.fingerprint_flips",
+    "config_hlo.top_target_bytes",
 )
 
 #: Loadgen-report metrics lifted into a ledger row. The

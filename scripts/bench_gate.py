@@ -28,6 +28,14 @@ warehouse & bench gate"):
   means the compiled program changed, e.g. a silent recompile-shape
   or fusion regression), and peak device memory bounded one-sided
   (growth past the band fails; shrinking passes).
+* **hlo** — the post-lowering lint plane (``config_hlo``: the
+  hlolint harvest summarized by ``porqua_tpu.analysis.hlo
+  .bench_hlo_part``): total and per-program-max GC201-GC206 finding
+  counts must not grow past the committed floor, HLO fingerprint
+  flips must be zero (a flip names a program that re-lowered
+  differently on an unchanged tree), program coverage must not
+  shrink, and the top fusion target's measured bytes are bounded
+  one-sided (a fusion win that shrinks them passes).
 
 A metric absent from the BASELINE is skipped (older artifacts predate
 newer payload parts — BENCH_r05 has no ``config_serving``); a metric
@@ -151,6 +159,26 @@ RULES = [
      "eq", 1, "invariant"),
     ("routing_unsolved", "config_routing.unsolved",
      "eq", 0, "invariant"),
+    # -- post-lowering HLO lint (config_hlo) ----------------------------
+    # The hlolint harvest (analysis/hlo.bench_hlo_part — emitted by
+    # bench.py's config_hlo part or hlolint_report.py --bench-part).
+    # Finding counts gate as ratio_max 1.0 against the committed
+    # floor: a floor of 0 makes ANY new finding fail (ratio inf) while
+    # a fix that lowers the count passes; fingerprint_flips is a
+    # baseline-independent zero bar; programs is coverage (a harvest
+    # that lost an entry point regressed); top_target_bytes is
+    # one-sided like the memory rules — the top fusion target's
+    # measured bytes may shrink (a fusion win) but not grow past 10%.
+    ("hlo_findings_total", "config_hlo.findings_total",
+     "ratio_max", 1.0, "hlo"),
+    ("hlo_findings_per_program", "config_hlo.findings_max_per_program",
+     "ratio_max", 1.0, "hlo"),
+    ("hlo_fingerprint_flips", "config_hlo.fingerprint_flips",
+     "eq", 0, "hlo"),
+    ("hlo_program_coverage", "config_hlo.programs",
+     "ge_base", None, "hlo"),
+    ("hlo_top_target_bytes", "config_hlo.top_target_bytes",
+     "ratio_max", 1.10, "hlo"),
     # -- tenancy: fairness / isolation invariants ----------------------
     # Multi-tenant artifacts (TENANT_rNN.json — serve_loadgen
     # --tenants reports) carry a tenant_fairness block; these are
@@ -412,7 +440,9 @@ def _selftest() -> int:
     # not carry — exercised in their own cell below).
     _part_rules = {"pdhg_te_band", "sketch_off_identity",
                    "routing_recompiles", "routing_reconciliation",
-                   "routing_unsolved"}
+                   "routing_unsolved", "hlo_findings_total",
+                   "hlo_findings_per_program", "hlo_fingerprint_flips",
+                   "hlo_program_coverage", "hlo_top_target_bytes"}
     assert all(c["class"] == "fairness" or c["name"] in _part_rules
                for c in v_good["checks"] if c["status"] == "skip"), v_good
 
@@ -513,6 +543,49 @@ def _selftest() -> int:
                  "routing_recompiles", "routing_reconciliation",
                  "routing_unsolved"):
         assert name in v_routed_bad["failed"], v_routed_bad["failed"]
+
+    # HLO cells: a fresh harvest at the committed floor (zero
+    # findings, no flips, bytes inside the band) passes; a payload
+    # with a new finding, a re-lowered program, a lost entry point,
+    # and a fatter top target fails exactly the hlo rules — and a
+    # fix that shrinks the counts/bytes passes one-sided.
+    hlo_base = json.loads(json.dumps(base))
+    hlo_base["config_hlo"] = {
+        "programs": 18, "findings_total": 0,
+        "findings_max_per_program": 0, "fingerprint_flips": 0,
+        "top_target_bytes": 5.0e8}
+    hlo_good = json.loads(json.dumps(hlo_base))
+    hlo_good["config_hlo"]["top_target_bytes"] *= 1.05
+    v_hlo = check_payload(hlo_base, hlo_good)
+    assert v_hlo["ok"], v_hlo["failed"]
+    hlo_bad = json.loads(json.dumps(hlo_base))
+    hlo_bad["config_hlo"] = {
+        "programs": 17,                    # coverage regressed
+        "findings_total": 2,               # new findings past floor 0
+        "findings_max_per_program": 2,
+        "fingerprint_flips": 1,            # a program re-lowered
+        "top_target_bytes": 5.0e8 * 1.3}   # top target fattened
+    v_hlo_bad = check_payload(hlo_base, hlo_bad)
+    assert not v_hlo_bad["ok"]
+    for name in ("hlo_findings_total", "hlo_findings_per_program",
+                 "hlo_fingerprint_flips", "hlo_program_coverage",
+                 "hlo_top_target_bytes"):
+        assert name in v_hlo_bad["failed"], v_hlo_bad["failed"]
+    # From a nonzero floor, a fix passes and a regression fails.
+    floor2 = json.loads(json.dumps(hlo_base))
+    floor2["config_hlo"]["findings_total"] = 2
+    fixed = json.loads(json.dumps(floor2))
+    fixed["config_hlo"]["findings_total"] = 1
+    fixed["config_hlo"]["top_target_bytes"] *= 0.6  # fusion win
+    assert check_payload(floor2, fixed)["ok"]
+    worse = json.loads(json.dumps(floor2))
+    worse["config_hlo"]["findings_total"] = 3
+    assert "hlo_findings_total" in check_payload(floor2, worse)["failed"]
+    # Losing the whole part against a baseline that had it is a
+    # coverage regression, not a skip.
+    v_hlo_lost = check_payload(hlo_base, base)
+    assert "hlo_fingerprint_flips" in v_hlo_lost["failed"], \
+        v_hlo_lost["failed"]
 
     # Trend cells: the SAME rule table gating against the rolling
     # median of a synthetic ledger. A candidate hovering at the

@@ -100,10 +100,11 @@ def _render_solver_table(agg: Dict[str, Any]) -> List[str]:
     if not any(len(g["by_solver"]) > 1 for g in rows):
         return []
     lines = [
-        "solver comparison (routing evidence per cell; win = seed pick):",
+        "solver comparison (routing evidence per cell; win = seed pick; "
+        "routed = router dispatches vs shadow re-solves):",
         f"{'tenant':<14} {'bucket':<12} {'eps_abs':>9} {'solver':<6} "
-        f"{'count':>6} {'p50':>6} {'p95':>6} {'solve_ms':>9} "
-        f"{'solved%':>8} {'win':>4}",
+        f"{'count':>6} {'routed':>6} {'p50':>6} {'p95':>6} "
+        f"{'solve_ms':>9} {'solved%':>8} {'win':>4}",
     ]
     for g in rows:
         eps = g["eps_abs"]
@@ -115,7 +116,8 @@ def _render_solver_table(agg: Dict[str, Any]) -> List[str]:
             lines.append(
                 f"{g.get('tenant', '-'):<14} {g['bucket']:<12} "
                 f"{(f'{eps:.0e}' if eps is not None else '-'):>9} "
-                f"{sv:<6} {e['count']:>6} {e['iters']['p50']:>6.0f} "
+                f"{sv:<6} {e['count']:>6} {e.get('routed', 0):>6} "
+                f"{e['iters']['p50']:>6.0f} "
                 f"{e['iters']['p95']:>6.0f} "
                 f"{(f'{lat * 1e3:.2f}' if lat is not None else '-'):>9} "
                 f"{solved:>7.0f}% {('*' if sv == winner else ''):>4}")
@@ -198,10 +200,19 @@ def _selftest() -> int:
     cell = next(g for g in agg3["groups"] if g["bucket"] == "32x4")
     assert set(cell["by_solver"]) == {"admm", "pdhg"}, cell
     assert _solver_winner(cell["by_solver"]) == "pdhg", cell
+    # Routed-decision counts: the 16 serve dispatches all ran on the
+    # router's pick (admm); the pdhg records are shadow re-solves, so
+    # its evidence cell shows count 16 but routed 0.
+    assert cell["by_solver"]["admm"]["routed"] == 16, cell
+    assert cell["by_solver"]["pdhg"]["routed"] == 0, cell
     text3 = render_table(agg3)
-    for needle in ("solver comparison", "pdhg", "serve.shadow x16"):
+    for needle in ("solver comparison", "pdhg", "serve.shadow x16",
+                   "routed"):
         assert needle in text3, f"selftest: {needle!r} missing:\n{text3}"
     assert text3.count("*") >= 1, text3
+    pdhg_row = next(ln for ln in text3.splitlines()
+                    if " pdhg " in f" {ln} " and "32x4" in ln)
+    assert " 16 " in pdhg_row and " 0 " in pdhg_row, pdhg_row
 
     print(text)
     print("\nharvest_report selftest: ok")

@@ -59,19 +59,33 @@ routing-identity contract (both solver backends' programs carry the
 GC101-103 proofs, and a live SolverRouter — a harvest-seeded route
 table consulted per bucket, a force() flip, a snapshot — leaves the
 solve/serve jaxprs of BOTH backends string-identical: routing picks
-which compiled program runs, it never touches a traced one). Exit
-status: 0 clean, 1 findings, 2 internal/usage error.
+which compiled program runs, it never touches a traced one). With
+``--hlo`` (or a ``--select`` naming any GC20x rule) the post-lowering
+plane runs too: :mod:`porqua_tpu.analysis.hlo` compiles every entry
+point via ``jit(...).lower(...).compile()`` and
+:mod:`porqua_tpu.analysis.hlolint` lints the optimized HLO text —
+GC201 fusion miss, GC202 redundant materialization, GC203 layout
+churn, GC204 bucket-ladder padding waste, GC205 temporary-peak
+budget, GC206 post-lowering dtype drift — against the committed
+``HLO_BASELINE.json`` (peak budgets, padding budgets, suppression
+table). Exit status: 0 clean, 1 findings, 2 internal/usage error.
 
 Options:
     --format {text,json}   output format (default text)
-    --select GC001,GC002   run only these AST rules
+    --select GC001,GC002   run only these rules (AST, contract, or
+                           GC20x HLO rules)
     --no-contracts         skip the jaxpr contract checks (used when
                            scanning fixture trees that are not the
                            real package)
+    --hlo                  also harvest + lint post-lowering HLO
+                           (GC201-GC206; ~18 AOT compiles, minutes on
+                           a cold cache)
     --stats                emit per-rule finding AND suppression
                            counts (JSON: a "stats" object in the
-                           payload; text: a summary block) so
-                           suppression creep is visible in CI output
+                           payload, schema 2; text: a summary block)
+                           so suppression creep is visible in CI
+                           output — covers AST, contract, and HLO
+                           rules alike
 
 Wired into scripts/run_tests.sh so the gate runs everywhere tests do.
 Suppressions: ``# graftcheck: disable=GC00x`` (line),
@@ -105,6 +119,9 @@ def main(argv=None) -> int:
                         help="comma-separated rule ids to run")
     parser.add_argument("--no-contracts", action="store_true",
                         help="skip the jaxpr entry-point contracts")
+    parser.add_argument("--hlo", action="store_true",
+                        help="harvest + lint post-lowering HLO "
+                             "(GC201-GC206)")
     parser.add_argument("--stats", action="store_true",
                         help="emit per-rule finding/suppression counts")
     args = parser.parse_args(argv)
@@ -154,23 +171,50 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 2
 
+    hlo_rules = {"GC201", "GC202", "GC203", "GC204", "GC205", "GC206"}
+    if args.hlo or (rules is not None and rules & hlo_rules):
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+            from porqua_tpu.analysis import hlo as hlo_harvest
+
+            findings += hlo_harvest.lint_harvest(
+                hlo_harvest.harvest_entry_points(),
+                baseline=hlo_harvest.load_baseline(),
+                rules=(rules & hlo_rules if rules is not None else None),
+                stats_out=stats if args.stats else None)
+        except Exception as exc:  # noqa: BLE001 - CLI boundary
+            # Same bar as the contracts: a harvest that errors is not
+            # a clean pass.
+            print(f"run_checks: HLO harvest failed: {exc!r}",
+                  file=sys.stderr)
+            return 2
+
     if rules is not None:
         # --select filters everything reported, including the jaxpr
-        # contract findings (the sweep itself runs per entry point, so
-        # the rule filter applies to its output). GC000 (file does not
-        # parse) is exempt: a file the linter cannot read must never
-        # report clean, whatever was selected.
+        # contract and HLO findings (those sweeps run per entry point
+        # or per program, so the rule filter applies to their output).
+        # GC000 (file does not parse) is exempt: a file the linter
+        # cannot read must never report clean, whatever was selected.
         findings = [f for f in findings
                     if f.rule in rules or f.rule == "GC000"]
 
     if args.stats:
-        # Contract findings land after the AST scan: recount per rule
-        # over the final (selected) finding list so the stats describe
-        # exactly what is reported.
+        # Contract and HLO findings land after the AST scan: recount
+        # per rule over the final (selected) finding list so the stats
+        # describe exactly what is reported. Schema 2 added the
+        # contract/HLO coverage: findings_by_rule spans GC1xx/GC2xx,
+        # suppressions_by_rule folds in the HLO baseline's table, and
+        # hlo_programs counts harvested programs when --hlo ran.
+        stats["schema"] = 2
         by_rule: dict = {}
         for f in findings:
             by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
         stats["findings_by_rule"] = by_rule
+        for rule, n in stats.get("hlo_suppressions_by_rule", {}).items():
+            sup = stats.setdefault("suppressions_by_rule", {})
+            sup[rule] = sup.get(rule, 0) + n
         stats["suppressions_total"] = sum(
             stats.get("suppressions_by_rule", {}).values())
 

@@ -1,0 +1,508 @@
+"""Post-lowering HLO lint plane (GC201-GC206).
+
+Seeded-violation coverage for every rule in
+:mod:`porqua_tpu.analysis.hlolint` — each test plants one defect in
+synthetic optimized-HLO text and asserts the rule id AND the anchor
+(the ``<hlo:program>`` virtual path + the HLO line) — plus the parser,
+the suppression table, the committed ``HLO_BASELINE.json`` artifact
+(clean at zero suppressions, one entry per harvested entry point), the
+``run_checks.py --stats`` schema pin, and the bench-gate hlo rule
+class on payload fixtures. Everything here is synthetic text: the only
+AOT compile lives in the ``slow``-marked end-to-end harvest test.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from porqua_tpu.analysis import hlolint
+from porqua_tpu.analysis.hlolint import (
+    Finding, LintConfig, apply_suppressions, check_dtype_drift,
+    check_fusion_miss, check_layout_churn, check_padding_waste,
+    check_redundant_materialization, check_temp_peak, hlo_path,
+    lint_module, parse_hlo, path_program, shape_bytes, shape_dtypes)
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# parser
+# ---------------------------------------------------------------------------
+
+_MODULE = """\
+HloModule jit_step, is_scheduled=true, entry_computation_layout={(f32[4,16]{1,0})->(f32[4,16]{1,0}, s32[])}
+
+%fused_computation (param_0: f32[4,16], param_1: f32[4,16]) -> f32[4,16] {
+  %param_0 = f32[4,16]{1,0} parameter(0)
+  %param_1 = f32[4,16]{1,0} parameter(1)
+  %mul = f32[4,16]{1,0} multiply(%param_0, %param_1)
+  ROOT %add = f32[4,16]{1,0} add(%mul, %param_1)
+}
+
+%region_sum (a: f32[], b: f32[]) -> f32[] {
+  %a = f32[] parameter(0)
+  %b = f32[] parameter(1)
+  ROOT %s = f32[] add(%a, %b)
+}
+
+ENTRY %main (p0: f32[4,16], p1: f32[4,16]) -> (f32[4,16], s32[]) {
+  %p0 = f32[4,16]{1,0} parameter(0)
+  %p1 = f32[4,16]{1,0} parameter(1)
+  %zero = f32[] constant(0)
+  %fusion = f32[4,16]{1,0} fusion(%p0, %p1), kind=kLoop, calls=%fused_computation, metadata={op_name="jit(step)/mul" source_file="x.py" source_line=7}
+  %red = f32[4]{0} reduce(%fusion, %zero), dimensions={1}, to_apply=%region_sum
+  %iota = s32[] constant(3)
+  ROOT %tuple = (f32[4,16]{1,0}, s32[]) tuple(%fusion, %iota)
+}
+"""
+
+
+def test_parser_structure():
+    mod = parse_hlo(_MODULE)
+    assert mod.name == "jit_step"
+    assert set(mod.computations) == {"fused_computation", "region_sum",
+                                     "main"}
+    assert mod.entry is not None and mod.entry.name == "main"
+    assert mod.entry.params == [("p0", "f32[4,16]"), ("p1", "f32[4,16]")]
+    fusion = mod.entry.by_name["fusion"]
+    assert fusion.opcode == "fusion"
+    assert fusion.operands == ("p0", "p1")
+    assert fusion.called == ("fused_computation",)
+    assert fusion.line == 20
+    red = mod.entry.by_name["red"]
+    assert red.called == ("region_sum",)
+    root = mod.entry.root
+    assert root is not None and root.name == "tuple" and root.is_root
+    # Fusion bodies vs scheduled computations: the fused body and the
+    # reducer lambda are not scheduled; ENTRY is.
+    assert set(mod.fusion_bodies()) == {"fused_computation"}
+    assert [c.name for c in mod.scheduled_computations()] == ["main"]
+
+
+def test_shape_arithmetic():
+    assert shape_bytes("f32[4,16]{1,0}") == 256
+    assert shape_bytes("f32[]") == 4
+    assert shape_bytes("pred[8]") == 8
+    assert shape_bytes("(f32[4,16]{1,0}, s32[])") == 260
+    assert shape_bytes("f64[2,3]") == 48
+    assert shape_dtypes("(f32[4]{0}, s32[], f64[2]{0})") == {
+        "f32", "s32", "f64"}
+
+
+def test_hlo_path_round_trip():
+    assert hlo_path("solve_batch[pdhg]") == "<hlo:solve_batch[pdhg]>"
+    assert path_program("<hlo:solve_batch[pdhg]>") == "solve_batch[pdhg]"
+    assert path_program("porqua_tpu/qp/admm.py") is None
+
+
+# ---------------------------------------------------------------------------
+# GC201 — fusion miss
+# ---------------------------------------------------------------------------
+
+def _elementwise_chain(n: int) -> str:
+    return f"""\
+HloModule seed, is_scheduled=true
+
+ENTRY %main (p0: f32[{n},{n}], p1: f32[{n},{n}]) -> f32[{n},{n}] {{
+  %p0 = f32[{n},{n}]{{1,0}} parameter(0)
+  %p1 = f32[{n},{n}]{{1,0}} parameter(1)
+  %mul = f32[{n},{n}]{{1,0}} multiply(%p0, %p1)
+  ROOT %add = f32[{n},{n}]{{1,0}} add(%mul, %p0)
+}}
+"""
+
+
+def test_gc201_seeded_fusion_miss():
+    mod = parse_hlo(_elementwise_chain(256))  # 256 KiB intermediate
+    found = check_fusion_miss(mod, "seedprog")
+    assert len(found) == 1
+    f = found[0]
+    assert f.rule == "GC201"
+    assert f.path == "<hlo:seedprog>"
+    assert f.line == 6  # the producer %mul
+    assert "multiply -> add" in f.message and "262144 B" in f.message
+
+
+def test_gc201_below_ridge_is_clean():
+    # A 4 KiB intermediate is latency noise, not a fusion target.
+    mod = parse_hlo(_elementwise_chain(32))
+    assert check_fusion_miss(mod, "p") == []
+
+
+def test_gc201_ranked_widest_first():
+    text = """\
+HloModule seed, is_scheduled=true
+
+ENTRY %main (p0: f32[256,256], p1: f32[512,512]) -> f32[512,512] {
+  %p0 = f32[256,256]{1,0} parameter(0)
+  %p1 = f32[512,512]{1,0} parameter(1)
+  %small = f32[256,256]{1,0} multiply(%p0, %p0)
+  %snext = f32[256,256]{1,0} add(%small, %p0)
+  %big = f32[512,512]{1,0} multiply(%p1, %p1)
+  ROOT %bnext = f32[512,512]{1,0} add(%big, %p1)
+}
+"""
+    found = check_fusion_miss(parse_hlo(text), "p")
+    assert [f.line for f in found] == [8, 6]  # %big outranks %small
+
+
+# ---------------------------------------------------------------------------
+# GC202 — redundant materialization
+# ---------------------------------------------------------------------------
+
+def _twin_fusions(operands2: str = "%p0, %p1") -> str:
+    return f"""\
+HloModule seed, is_scheduled=true
+
+%fc.1 (a.1: f32[64,64], b.1: f32[64,64]) -> f32[64,64] {{
+  %a.1 = f32[64,64]{{1,0}} parameter(0)
+  %b.1 = f32[64,64]{{1,0}} parameter(1)
+  %m.1 = f32[64,64]{{1,0}} multiply(%a.1, %b.1)
+  ROOT %s.1 = f32[64,64]{{1,0}} subtract(%m.1, %b.1)
+}}
+
+%fc.2 (a.2: f32[64,64], b.2: f32[64,64]) -> f32[64,64] {{
+  %a.2 = f32[64,64]{{1,0}} parameter(0)
+  %b.2 = f32[64,64]{{1,0}} parameter(1)
+  %m.2 = f32[64,64]{{1,0}} multiply(%a.2, %b.2)
+  ROOT %s.2 = f32[64,64]{{1,0}} subtract(%m.2, %b.2)
+}}
+
+ENTRY %main (p0: f32[64,64], p1: f32[64,64]) -> f32[64,64] {{
+  %p0 = f32[64,64]{{1,0}} parameter(0)
+  %p1 = f32[64,64]{{1,0}} parameter(1)
+  %f1 = f32[64,64]{{1,0}} fusion(%p0, %p1), kind=kLoop, calls=%fc.1
+  %f2 = f32[64,64]{{1,0}} fusion({operands2}), kind=kLoop, calls=%fc.2
+  ROOT %o = f32[64,64]{{1,0}} add(%f1, %f2)
+}}
+"""
+
+
+def test_gc202_seeded_twin_call_sites():
+    found = check_redundant_materialization(
+        parse_hlo(_twin_fusions()), "seedprog")
+    assert len(found) == 1
+    f = found[0]
+    assert f.rule == "GC202" and f.path == "<hlo:seedprog>"
+    assert f.line == 21  # the second call site %f2
+    assert "f2" in f.message and "f1" in f.message
+
+
+def test_gc202_distinct_operands_are_clean():
+    # XLA clones one fusion body per call site by design (unrolled
+    # segment steps): identical bodies over DIFFERENT operands
+    # recompute nothing and must not fire.
+    found = check_redundant_materialization(
+        parse_hlo(_twin_fusions(operands2="%p1, %p0")), "p")
+    assert found == []
+
+
+def test_gc202_byte_floor():
+    # The same twins under the floor are XLA-CSE noise (the committed
+    # tree carries one 48 B 0/D pair in ruiz scaling — README triage).
+    found = check_redundant_materialization(
+        parse_hlo(_twin_fusions()), "p", min_bytes=1 << 20)
+    assert found == []
+
+
+def test_gc202_duplicate_dot():
+    text = """\
+HloModule seed, is_scheduled=true
+
+ENTRY %main (p0: f32[32,32], p1: f32[32,32]) -> f32[32,32] {
+  %p0 = f32[32,32]{1,0} parameter(0)
+  %p1 = f32[32,32]{1,0} parameter(1)
+  %d1 = f32[32,32]{1,0} dot(%p0, %p1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  %d2 = f32[32,32]{1,0} dot(%p0, %p1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  ROOT %o = f32[32,32]{1,0} add(%d1, %d2)
+}
+"""
+    found = check_redundant_materialization(parse_hlo(text), "p")
+    assert len(found) == 1
+    assert found[0].rule == "GC202" and found[0].line == 7
+    assert "dot" in found[0].message
+
+
+# ---------------------------------------------------------------------------
+# GC203 — layout churn
+# ---------------------------------------------------------------------------
+
+def test_gc203_seeded_churn():
+    text = """\
+HloModule seed, is_scheduled=true
+
+ENTRY %main (p0: f32[128,128]) -> f32[128,128] {
+  %p0 = f32[128,128]{1,0} parameter(0)
+  %t = f32[128,128]{0,1} transpose(%p0), dimensions={1,0}
+  ROOT %c = f32[128,128]{1,0} copy(%t)
+}
+"""
+    found = check_layout_churn(parse_hlo(text), "seedprog")
+    assert len(found) == 1
+    f = found[0]
+    assert f.rule == "GC203" and f.path == "<hlo:seedprog>"
+    assert f.line == 6 and "transpose" in f.message
+
+
+def test_gc203_single_move_and_bitcast_are_clean():
+    text = """\
+HloModule seed, is_scheduled=true
+
+ENTRY %main (p0: f32[128,128]) -> f32[128,128] {
+  %p0 = f32[128,128]{1,0} parameter(0)
+  %b = f32[16384]{0} bitcast(%p0)
+  %t = f32[128,128]{0,1} transpose(%p0), dimensions={1,0}
+  ROOT %a = f32[128,128]{0,1} add(%t, %t)
+}
+"""
+    assert check_layout_churn(parse_hlo(text), "p") == []
+
+
+# ---------------------------------------------------------------------------
+# GC204 — padding waste
+# ---------------------------------------------------------------------------
+
+def test_gc204_seeded_over_budget():
+    found = check_padding_waste("bucket_ladder[512x8]",
+                                natural_bytes=1000.0,
+                                padded_bytes=10000.0, budget=0.25,
+                                bucket="512x8", line=5)
+    assert len(found) == 1
+    f = found[0]
+    assert f.rule == "GC204" and f.path == "<hlo:bucket_ladder[512x8]>"
+    assert f.line == 5 and "0.900" in f.message and "512x8" in f.message
+
+
+def test_gc204_within_budget_is_clean():
+    assert check_padding_waste("b", natural_bytes=9000.0,
+                               padded_bytes=10000.0, budget=0.25) == []
+    # Degenerate inputs check nothing rather than dividing by zero.
+    assert check_padding_waste("b", natural_bytes=10.0,
+                               padded_bytes=0.0) == []
+
+
+def test_gc204_module_form_reads_entry_params():
+    mod = parse_hlo(_elementwise_chain(64))
+    # Two 16 KiB params = 32 KiB padded; a 1 KiB natural payload is
+    # ~97% dead.
+    found = check_padding_waste("p", natural_bytes=1024.0, module=mod,
+                                budget=0.5)
+    assert len(found) == 1 and found[0].line == mod.entry.line
+
+
+# ---------------------------------------------------------------------------
+# GC205 — temporary-peak budget
+# ---------------------------------------------------------------------------
+
+def test_gc205_seeded_over_budget():
+    found = check_temp_peak("seedprog", peak_bytes=2.0e6,
+                            budget_bytes=1.5e6, line=2)
+    assert len(found) == 1
+    f = found[0]
+    assert f.rule == "GC205" and f.path == "<hlo:seedprog>"
+    assert f.line == 2
+    assert "2000000" in f.message and "1500000" in f.message
+
+
+def test_gc205_absent_measurement_checks_nothing():
+    assert check_temp_peak("p", None, 1.0e6) == []
+    assert check_temp_peak("p", 1.0e6, None) == []
+    assert check_temp_peak("p", 1.0e6, 1.0e6) == []
+
+
+# ---------------------------------------------------------------------------
+# GC206 — post-lowering dtype drift
+# ---------------------------------------------------------------------------
+
+_WIDE = """\
+HloModule seed, is_scheduled=true
+
+ENTRY %main (p0: f32[32,32]) -> f32[32,32] {
+  %p0 = f32[32,32]{1,0} parameter(0)
+  %wide = f64[32,32]{1,0} convert(%p0)
+  %w2 = f64[32,32]{1,0} convert(%p0)
+  ROOT %narrow = f32[32,32]{1,0} convert(%wide)
+}
+"""
+
+
+def test_gc206_seeded_drift():
+    found = check_dtype_drift(parse_hlo(_WIDE), "seedprog")
+    # One finding per (computation, opcode): both converts collapse.
+    assert len(found) == 1
+    f = found[0]
+    assert f.rule == "GC206" and f.path == "<hlo:seedprog>"
+    assert f.line == 5 and "f64" in f.message
+
+
+def test_gc206_respects_float_policy():
+    assert check_dtype_drift(parse_hlo(_WIDE), "p",
+                             expect_float="f64") == []
+
+
+# ---------------------------------------------------------------------------
+# orchestration: lint_module, rule filter, suppressions
+# ---------------------------------------------------------------------------
+
+def test_lint_module_clean_tree_shape():
+    # A well-fused module with a single call site per body: clean.
+    mod = parse_hlo(_twin_fusions())
+    clean = _twin_fusions().replace(
+        "%f2 = f32[64,64]{1,0} fusion(%p0, %p1), kind=kLoop, calls=%fc.2",
+        "%f2 = f32[64,64]{1,0} fusion(%p1, %p0), kind=kLoop, calls=%fc.2")
+    assert lint_module(parse_hlo(clean), "p") == []
+    # The seeded one fires exactly GC202; the rules filter can turn it
+    # off without touching the others.
+    assert [f.rule for f in lint_module(mod, "p")] == ["GC202"]
+    assert lint_module(mod, "p", rules=["GC201", "GC206"]) == []
+
+
+def test_lint_config_thresholds_flow_through():
+    cfg = LintConfig(dup_min_bytes=1 << 20)
+    assert lint_module(parse_hlo(_twin_fusions()), "p", config=cfg) == []
+
+
+def test_suppressions_require_reason():
+    findings = [Finding("GC202", hlo_path("a"), 1, 1, "x"),
+                Finding("GC202", hlo_path("b"), 2, 1, "y"),
+                Finding("GC205", hlo_path("a"), 3, 1, "z")]
+    kept, counts = apply_suppressions(findings, [
+        {"program": "a", "rule": "GC202", "reason": "known twin"},
+        {"program": "a", "rule": "GC205"},  # reasonless: ignored
+    ])
+    assert counts == {"GC202": 1}
+    assert [(f.rule, path_program(f.path)) for f in kept] == [
+        ("GC202", "b"), ("GC205", "a")]
+    # Wildcard program suppresses the rule everywhere.
+    kept2, counts2 = apply_suppressions(findings, [
+        {"program": "*", "rule": "GC202", "reason": "sweep"}])
+    assert counts2 == {"GC202": 2} and [f.rule for f in kept2] == ["GC205"]
+
+
+# ---------------------------------------------------------------------------
+# the committed baseline artifact
+# ---------------------------------------------------------------------------
+
+def test_committed_baseline_is_clean():
+    """The shipped HLO_BASELINE.json: schema-pinned, one entry per
+    entry-point program, zero finding floors, zero suppressions, and a
+    budget for every padding cell — the 'full tree scan committed
+    clean at zero suppressions' bar."""
+    from porqua_tpu.analysis import hlo
+
+    path = os.path.join(_ROOT, "HLO_BASELINE.json")
+    assert os.path.exists(path), "HLO_BASELINE.json must be committed"
+    with open(path) as f:
+        baseline = json.load(f)
+    assert baseline["schema"] == hlo.BASELINE_SCHEMA_VERSION
+    assert baseline["suppressions"] == []
+    programs = baseline["programs"]
+    expected = {label for label, _, _ in hlo.entry_point_programs()}
+    assert set(programs) == expected
+    for label, entry in programs.items():
+        assert entry["findings_by_rule"] == {}, (label, entry)
+        assert entry["fingerprint"], label
+        assert entry["peak_budget"] is None or (
+            entry["peak_budget"] > entry["peak_bytes"]), label
+    cells = baseline["padding"]["cells"]
+    budgets = baseline["padding"]["budgets"]
+    assert {c["bucket"] for c in cells} == set(budgets)
+    for c in cells:
+        assert budgets[c["bucket"]] > c["share"], c
+    # The committed budgets hold against the CURRENT ladder arithmetic
+    # (a ladder change that worsens a cell must fail this).
+    from porqua_tpu.analysis.hlo import bucket_padding_cells, padding_findings
+    assert padding_findings(bucket_padding_cells(),
+                            budgets=budgets) == []
+
+
+@pytest.mark.slow
+def test_end_to_end_harvest_single_program():
+    """One real AOT compile through the whole plane: harvest ->
+    fingerprint -> lint clean against the committed baseline."""
+    from porqua_tpu.analysis import hlo
+
+    baseline = hlo.load_baseline()
+    assert baseline is not None
+    programs = hlo.harvest_entry_points(labels=["tracking_step"])
+    assert len(programs) == 1
+    hp = programs[0]
+    assert hp.hlo_text and hp.fingerprint
+    assert hp.record["kind"] == "hlolint"
+    stats: dict = {}
+    findings = hlo.lint_harvest(programs, baseline=baseline,
+                                include_padding=False, stats_out=stats)
+    assert findings == [], [f.format() for f in findings]
+    assert stats["hlo_programs"] == 1
+    diff = hlo.compare_fingerprints(baseline, programs)
+    assert diff["flipped"] == [], diff
+
+
+# ---------------------------------------------------------------------------
+# run_checks --stats schema pin + bench_gate hlo rules
+# ---------------------------------------------------------------------------
+
+def test_run_checks_stats_schema_v2(tmp_path):
+    """The --stats JSON contract is schema 2: findings_by_rule spans
+    every plane (recounted over the final finding list), and the
+    suppression totals fold in HLO-baseline suppressions. Pinned by
+    subprocess (the CLI is the contract surface)."""
+    fixture = tmp_path / "mod.py"
+    fixture.write_text(
+        "import jax\n"
+        "import jax.numpy as jnp\n\n\n"
+        "def f(x):\n"
+        "    return jnp.float64(x)  # graftcheck: disable=GC001\n")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "scripts", "run_checks.py"),
+         str(fixture), "--no-contracts", "--format", "json", "--stats"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    payload = json.loads(proc.stdout)
+    stats = payload["stats"]
+    assert stats["schema"] == 2
+    assert stats["files"] == 1
+    assert stats["findings_by_rule"] == {}
+    assert stats["suppressions_by_rule"] == {"GC001": 1}
+    assert stats["suppressions_total"] == 1
+    # The GC20x rules are documented next to the AST/jaxpr ones.
+    for rule in hlolint.HLO_RULES:
+        assert rule in payload["rules"], rule
+
+
+def test_bench_gate_hlo_rules(tmp_path):
+    """The hlo rule class end to end through the CLI: a fresh payload
+    at the committed floor passes; new findings / a fingerprint flip /
+    lost coverage / fatter top-target bytes fail."""
+    sys.path.insert(0, os.path.join(_ROOT, "scripts"))
+    try:
+        import bench_gate
+    finally:
+        sys.path.pop(0)
+    part = {"programs": 19, "findings_total": 0,
+            "findings_max_per_program": 0, "fingerprint_flips": 0,
+            "top_target_bytes": 4.0e8}
+    base = {"config_hlo": dict(part)}
+    good = {"config_hlo": dict(part, top_target_bytes=4.2e8)}
+    v = bench_gate.check_payload(base, good)
+    hlo_rows = {c["name"]: c["status"] for c in v["checks"]
+                if c["class"] == "hlo"}
+    assert set(hlo_rows) == {
+        "hlo_findings_total", "hlo_findings_per_program",
+        "hlo_fingerprint_flips", "hlo_program_coverage",
+        "hlo_top_target_bytes"}
+    assert all(s == "pass" for s in hlo_rows.values()), hlo_rows
+    bad = {"config_hlo": dict(part, findings_total=1,
+                              findings_max_per_program=1,
+                              fingerprint_flips=2, programs=18,
+                              top_target_bytes=6.0e8)}
+    v_bad = bench_gate.check_payload(base, bad)
+    assert set(v_bad["failed"]) >= set(hlo_rows), v_bad["failed"]
+    # Ledger trend coverage: the config_hlo paths ride BENCH_METRICS.
+    from porqua_tpu.obs import ledger
+    metrics = ledger.metrics_from_bench(good)
+    assert metrics["config_hlo.top_target_bytes"] == 4.2e8
+    assert metrics["config_hlo.findings_total"] == 0

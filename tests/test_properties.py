@@ -12,7 +12,13 @@ reference's raised RuntimeError at ``backtest.py:193-197``).
 
 import numpy as np
 import jax.numpy as jnp
-from hypothesis import given, settings, strategies as st
+import pytest
+
+hypothesis = pytest.importorskip(
+    "hypothesis",
+    reason="hypothesis not installed in this environment; the "
+           "property suite needs its strategies")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from porqua_tpu.qp.admm import SolverParams, Status
 from porqua_tpu.qp.canonical import CanonicalQP, stack_qps
