@@ -634,6 +634,11 @@ def device_child(platform: str, n_dates: int) -> None:
             else:
                 log(f"skipping cpu routing config "
                     f"({child_left():.0f}s left)")
+            if child_left() > 120:
+                _secondary_config_calibration(child_left)
+            else:
+                log(f"skipping cpu calibration config "
+                    f"({child_left():.0f}s left)")
             if child_left() > 45:
                 _secondary_config4(params, child_left, Xs_np, ys_np,
                                    n_dates=8)
@@ -692,6 +697,11 @@ def device_child(platform: str, n_dates: int) -> None:
             _secondary_config_routing(child_left)
         else:
             log(f"skipping routing config ({child_left():.0f}s left)")
+        if child_left() > 120:
+            _secondary_config_calibration(child_left)
+        else:
+            log(f"skipping calibration config "
+                f"({child_left():.0f}s left)")
         if child_left() > 90:
             _secondary_config4(params_sec, child_left, Xs_np, ys_np)
         else:
@@ -1384,6 +1394,137 @@ def _secondary_config_routing(child_left, n_small=24, n_large=96,
         f"{snap_b['routed_admm']}/{snap_b['routed_pdhg']}; recompiles "
         f"{snap_b['compiles']}; reconciled "
         f"{payload['harvest_reconciled']}; unsolved {unsolved}")
+
+
+def _secondary_config_calibration(child_left, n_large=96, per_bucket=24,
+                                  max_batch=8):
+    """Closed-loop calibration, cold start: the router begins with an
+    EMPTY route table and a live :class:`porqua_tpu.obs.Calibrator`
+    must promote PDHG on the exposure-banded bucket from its own
+    shadow stream — candidate → canary dwell → versioned table swap —
+    on a stepped clock (the state machine advances only when the bench
+    steps it, so the run is deterministic). The measured phase then
+    serves routed with shadows off. Acceptance:
+    ``recompiles_after_warmup == 0`` (the swap lands on prewarmed
+    executables), ``harvest_reconciled == 1``, ``promotions == 1``
+    with the exposure cell routed to PDHG, and the audit chain in the
+    warehouse replaying to exactly the active table/version."""
+    from porqua_tpu.obs.calibrate import Calibrator, replay_audit
+    from porqua_tpu.obs.harvest import HarvestSink
+    from porqua_tpu.qp.solve import SolverParams
+    from porqua_tpu.resilience.faults import FaultClock
+    from porqua_tpu.serve import SolveService, SolverRouter
+    from porqua_tpu.serve.loadgen import build_exposure_requests
+
+    params = SolverParams(max_iter=4000, eps_abs=1e-5, eps_rel=1e-5,
+                          polish=False, check_interval=25)
+    log(f"config calibration (cold start, n={n_large}, "
+        f"{per_bucket}/round)...")
+    # The PDHG-regime population only (exposure-banded mean-variance
+    # QPs): config_routing already proves the two-cell table; this
+    # config proves the LIVE loop earns the same answer from nothing.
+    reqs = build_exposure_requests(per_bucket, n_assets=n_large,
+                                   n_rows=16, seed=12)
+    clk = FaultClock()
+    sink = HarvestSink()
+    router = SolverRouter(params, shadow_rate=1.0, shadow_seed=0)
+    cal = Calibrator(min_interval_s=0.0, min_samples=8, win_rate=0.6,
+                     canary_dwell_s=5.0, guard_window_s=10.0,
+                     clock=clk)
+    svc = SolveService(params=params, max_batch=max_batch,
+                       max_wait_ms=1.0, router=router, harvest=sink,
+                       calibrator=cal)
+    svc.start()
+    try:
+        svc.prewarm(reqs[0])  # router.prewarm: BOTH backends' ladders
+
+        def round_trip():
+            for t in [svc.submit(q) for q in reqs]:
+                svc.result(t, timeout=300)
+
+        # Warmup round (loadgen protocol — same rationale as
+        # config_routing: the shadow re-solve runs second, so without
+        # this the latency evidence is biased against the server).
+        round_trip()
+        time.sleep(0.25)
+        svc.metrics.reset_window()
+
+        # Evidence round: shadows at 1.0 fold PDHG comparisons into
+        # the calibrator through the live observe() feed; the plane
+        # ticks fire on every dispatch (min_interval_s=0) but the
+        # stepped clock holds the canary dwell open.
+        round_trip()
+        time.sleep(0.25)  # trailing shadow re-solve off dispatch thread
+        cal.tick()        # fold any just-landed evidence -> candidate
+        state_after_evidence = cal.status()["state"]
+        clk.advance(6.0)  # > canary_dwell_s
+        cal.tick()        # canary held through dwell -> promote
+        promoted_table = dict(router.snapshot()["table"])
+        clk.advance(11.0)  # > guard_window_s, no anomaly/slo breach
+        cal.tick()         # guard settles
+
+        # Measured phase: routed serving, shadows off.
+        router.shadow_rate = 0.0
+        skip = len(sink.buffered())
+        svc.metrics.reset_window()
+        t0 = time.perf_counter()
+        tickets = [svc.submit(q) for q in reqs]
+        results = [svc.result(t, timeout=300) for t in tickets]
+        wall = time.perf_counter() - t0
+        snap = svc.metrics.snapshot()
+        recs = sink.buffered()[skip:]
+    finally:
+        svc.stop()
+    serve_recs = [r for r in recs if r["source"] == "serve"]
+    unsolved = sum(r.status != 1 for r in results)
+    counters = cal.counters()
+    rsnap = router.snapshot()
+    replayed, replay_version = replay_audit(sink.buffered())
+    cell = next(iter(sorted(promoted_table)), None)
+    evidence = cal.evidence()
+    shadow = (evidence.get(cell, {}).get("shadow", {}).get("pdhg")
+              if cell else None)
+    payload = {
+        "part": "config_calibration",
+        "n_requests": len(reqs),
+        "max_batch": max_batch,
+        "eps": params.eps_abs,
+        "state_after_evidence": state_after_evidence,
+        "promoted_table": promoted_table,
+        "route_table": rsnap["table"],
+        "route_table_version": rsnap["table_version"],
+        "promotions": counters["calibration_promotions"],
+        "rollbacks": counters["calibration_rollbacks"],
+        "rejected": counters["calibration_rejected"],
+        "win_rate": None if shadow is None else shadow["win_rate"],
+        "evidence": evidence,
+        "audit_records": len(cal.audit_records()),
+        # The warehouse audit chain alone must rebuild the live table.
+        "audit_replay_ok": int(replayed == rsnap["table"]
+                               and replay_version
+                               == rsnap["table_version"]),
+        "routed_admm": snap["routed_admm"],
+        "routed_pdhg": snap["routed_pdhg"],
+        "recompiles_after_warmup": snap["compiles"],
+        "unsolved": int(unsolved),
+        "seconds": wall,
+        "harvest_reconciled": int(
+            len(serve_recs) == len(results) == snap["completed"]
+            and all("solver" in r for r in serve_recs)),
+        "note": "cold start: empty route table, live shadow evidence "
+                "promotes PDHG on the exposure-banded cell through "
+                "candidate/canary/guard on a stepped clock; acceptance "
+                "is promotions == 1, recompiles_after_warmup == 0 "
+                "(prewarmed-both-ladders), harvest_reconciled == 1, "
+                "audit_replay_ok == 1",
+    }
+    _emit(payload)
+    log(f"config calibration: state {state_after_evidence} -> table "
+        f"{promoted_table} v{rsnap['table_version']}; promotions "
+        f"{payload['promotions']}; win_rate {payload['win_rate']}; "
+        f"recompiles {snap['compiles']}; reconciled "
+        f"{payload['harvest_reconciled']}; replay "
+        f"{payload['audit_replay_ok']}")
 
 
 def _secondary_config5(params, child_left, n_bench=24, n_dates=63,

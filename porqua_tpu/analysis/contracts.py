@@ -913,6 +913,132 @@ def check_routing_identity(dtype=np.float32) -> List[Finding]:
     return findings
 
 
+def check_calibration_identity(dtype=np.float32) -> List[Finding]:
+    """GC111: the closed calibration loop must be invisible to XLA.
+
+    The :class:`porqua_tpu.obs.calibrate.Calibrator` closes the
+    telemetry→action loop — live shadow evidence folded into rolling
+    per-cell statistics, a staged promotion swapping the router's
+    versioned route table, a guard window auto-reverting on drift.
+    All of it is host-side dispatch SELECTION: it may only ever change
+    which prewarmed executable a batch runs on. This check traces both
+    backends' solve/serve entry points bare, then drives a live
+    calibrator through the ENTIRE lifecycle on a stepped clock —
+    evidence ingested (valid + rejected records), a candidate gated
+    into canary, a promotion (version bump), a guard breach, the
+    auto-rollback (another version bump), the audit chain replayed —
+    and re-traces MID-LIFECYCLE (canary held) and after. Every jaxpr
+    must be string-identical, and the probe self-verifies each
+    transition actually happened (a calibrator that never promoted
+    proves nothing).
+    """
+    import dataclasses
+
+    from porqua_tpu.obs.calibrate import Calibrator, replay_audit
+    from porqua_tpu.obs.events import EventBus
+    from porqua_tpu.obs.harvest import HarvestSink, solve_record
+    from porqua_tpu.qp.solve import SolverParams
+    from porqua_tpu.resilience.faults import FaultClock
+    from porqua_tpu.serve.routing import SolverRouter
+
+    params = SolverParams()
+
+    def trace_all():
+        out = []
+        for method in ("admm", "pdhg"):
+            p = dataclasses.replace(params, method=method)
+            out.append((f"solve_batch[{method}]",
+                        str(solve_batch_jaxpr(params=p, dtype=dtype))))
+            out.append((f"serve_entry[{method}]",
+                        str(serve_entry_jaxpr(params=p, dtype=dtype))))
+        return out
+
+    findings: List[Finding] = []
+    baseline = trace_all()
+
+    def probe_fail(msg: str) -> None:
+        findings.append(Finding(
+            "GC111", "<jaxpr:calibration_identity>", 0, 0, msg))
+
+    class _GuardAnomaly:
+        """Anomaly-counter stand-in the probe flips to breach the
+        guard window deterministically (the real detector's counters()
+        shape)."""
+
+        fired = 0
+
+        def counters(self):
+            return {"anomalies_fired": self.fired}
+
+    clock = FaultClock()
+    router = SolverRouter(params)
+    events = EventBus()
+    sink = HarvestSink()
+    guard = _GuardAnomaly()
+    cal = Calibrator(router=router, harvest=sink, events=events,
+                     anomaly=guard, min_interval_s=1.0, min_samples=4,
+                     win_rate=0.6, canary_dwell_s=2.0,
+                     guard_window_s=30.0, clock=clock)
+    eps = float(params.eps_abs)
+    p_admm = dataclasses.replace(params, method="admm")
+    p_pdhg = dataclasses.replace(params, method="pdhg")
+    for _ in range(6):
+        cal.observe(solve_record(
+            "serve", 16, 4, 1, 40, 1e-6, 1e-6, -1.0, params=p_admm,
+            bucket="16x4", solve_s=4e-3))
+        cal.observe(solve_record(
+            "serve.shadow", 16, 4, 1, 12, 1e-6, 1e-6, -1.0,
+            params=p_pdhg, bucket="16x4", solve_s=1e-3,
+            shadow_of="admm", delta_iters=-28, delta_obj=0.0,
+            agree=True, delta_solve_s=-3e-3))
+    rejected = cal.observe(solve_record(
+        "serve", 16, 4, 1, 40, 1e-6, 1e-6, float("nan"),
+        params=p_admm, bucket="16x4"))
+    clock.advance(1.5)
+    cal.maybe_tick()
+    if rejected is not False or cal.status()["state"] != "canary":
+        probe_fail("the calibration probe did not reach canary with "
+                   "the poison record rejected — the identity check "
+                   f"exercised a broken loop (status={cal.status()})")
+
+    # Mid-promotion: the candidate is live, the dwell is running.
+    mid = trace_all()
+
+    clock.advance(2.5)
+    cal.maybe_tick()   # dwell held -> promoted, guard window opens
+    promoted = router.snapshot()
+    guard.fired = 1    # policy-induced drift: breach the guard
+    clock.advance(1.5)
+    cal.maybe_tick()   # breach -> auto-rollback
+    snap = router.snapshot()
+    table, version = replay_audit(cal.audit_records())
+    counters = cal.counters()
+    if (promoted["table"] != {f"16x4@{eps:.0e}": "pdhg"}
+            or promoted["table_version"] != 1
+            or snap["table"] != {} or snap["table_version"] != 2
+            or (table, version) != (snap["table"], 2)
+            or counters["calibration_promotions"] != 1
+            or counters["calibration_rollbacks"] != 1):
+        probe_fail("the calibration probe did not promote, roll back "
+                   "and replay its audit chain as expected — the "
+                   "identity check exercised a broken loop "
+                   f"(promoted={promoted}, snap={snap}, "
+                   f"counters={counters})")
+
+    live = trace_all()
+    for traced in (mid, live):
+        for (label, base), (_, lv) in zip(baseline, traced):
+            if base != lv:
+                findings.append(Finding(
+                    "GC111", f"<jaxpr:{label}>", 0, 0,
+                    "traced program differs with a live Calibrator "
+                    "mid-promotion: calibration is no longer "
+                    "host-side dispatch selection only (disabled-"
+                    "bit-identity contract broken)"))
+                break
+    return findings
+
+
 def run_batch_jaxpr(bs, params=None, dtype=np.float32) -> ClosedJaxpr:
     """Trace ``run_batch``'s device core against a *real*
     ``BacktestService``: the host pass (``build_problems``) runs for
@@ -1049,4 +1175,10 @@ def check_entry_points(dtype=np.float32,
     # identical (routing picks which compiled program runs, it never
     # touches a traced one).
     findings += check_routing_identity(dtype=dtype)
+    # GC111: and for the closed calibration loop — evidence folded,
+    # a candidate promoted through canary, a guard breach rolled back,
+    # the audit chain replayed — all of it must leave both backends'
+    # traced solve/serve programs string-identical (calibration only
+    # ever picks which prewarmed executable runs).
+    findings += check_calibration_identity(dtype=dtype)
     return findings

@@ -101,6 +101,7 @@ RULE_DOCS = {
     "GC108": "fleet federation plane perturbs a traced program",
     "GC109": "tenant plane perturbs a traced program",
     "GC110": "solver routing perturbs a traced program",
+    "GC111": "calibration loop perturbs a traced program",
     # Post-lowering HLO rules (porqua_tpu/analysis/hlolint.py): run
     # over the optimized HLO harvested from every entry-point program
     # (analysis/hlo.py), not over source text — what XLA emitted, not

@@ -57,6 +57,7 @@ in it runs on the request hot path beyond lock-bounded appends.
 """
 
 from porqua_tpu.obs.anomaly import AnomalyDetector
+from porqua_tpu.obs.calibrate import Calibrator, replay_audit
 from porqua_tpu.obs.devprof import (
     CostLog,
     ProfileWindow,

@@ -3,7 +3,11 @@
 Metrics answer "how much"; the event bus answers "what happened, when,
 to which request": compile events from the executable cache,
 circuit-breaker transitions from the device-health manager, sanitizer
-violations, backpressure rejections, and deadline expiries are each
+violations, backpressure rejections, deadline expiries, and the
+calibration plane's route-table lifecycle (``route_reseed`` on every
+candidate/promoted/abandoned/settled transition with the evidence
+diff; ``route_rollback`` when the post-promotion guard reverts a
+table — a flight-recorder trigger) are each
 one structured record stamped with a severity and (where one exists)
 the request's trace id, so a latency outlier in the span timeline
 cross-references to the compile or breaker flip that caused it.

@@ -8,7 +8,12 @@ an info-style labeled gauge. :class:`ObsHTTPServer` is the zero-
 dependency scrape endpoint — ``http.server.ThreadingHTTPServer`` on a
 daemon thread serving ``/metrics`` (exposition) and ``/healthz``
 (JSON liveness + degradation) — started via
-``SolveService.start_http()``. Metric names: README "Observability".
+``SolveService.start_http()``. Plane gauges ride the same snapshot:
+a wired :class:`~porqua_tpu.obs.calibrate.Calibrator` surfaces its
+``calibration_*`` counters and gauges (route-table version, state-
+machine position, promotion/rollback totals, last-reseed age) here
+and its full status section on ``/healthz``. Metric names: README
+"Observability".
 """
 
 from __future__ import annotations

@@ -58,7 +58,11 @@ BUNDLE_VERSION = 1
 #: ``worker_lost`` and ``vitals_anomaly`` come from the fleet plane
 #: (:mod:`porqua_tpu.obs.federation` / :mod:`porqua_tpu.obs.vitals`):
 #: a crashed loadgen shard or a leaking worker must land an incident
-#: bundle, not a silent throughput dip.
+#: bundle, not a silent throughput dip. ``route_rollback`` comes from
+#: the calibration plane (:mod:`porqua_tpu.obs.calibrate`): a promoted
+#: route table the guard window had to revert is an incident — the
+#: bundle carries the evidence diff that promoted it and the breach
+#: that shot it down.
 DEFAULT_TRIGGERS = (
     "breaker_open",
     "retry_giveup",
@@ -69,6 +73,7 @@ DEFAULT_TRIGGERS = (
     "convergence_anomaly",
     "worker_lost",
     "vitals_anomaly",
+    "route_rollback",
 )
 
 #: Kinds whose events carry an alert ``state`` — only the firing edge

@@ -440,11 +440,20 @@ def aggregate(records: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
     sources: Dict[str, int] = {}
     tenants: Dict[str, int] = {}
     ring_records = 0
+    annotations = 0
     for rec in records:
+        src = str(rec.get("source", "?"))
+        if "iters" not in rec:
+            # Annotation records (the calibration plane's
+            # ``calibration.audit`` chain) share the dataset but are
+            # not solves: counted per source, excluded from the
+            # per-cell solve statistics.
+            sources[src] = sources.get(src, 0) + 1
+            annotations += 1
+            continue
         tenant = str(rec.get("tenant", LEGACY_TENANT))
         key = (tenant, str(rec.get("bucket", "?")), rec.get("eps_abs"))
         groups.setdefault(key, []).append(rec)
-        src = str(rec.get("source", "?"))
         sources[src] = sources.get(src, 0) + 1
         tenants[tenant] = tenants.get(tenant, 0) + 1
         if rec.get("ring"):
@@ -525,6 +534,7 @@ def aggregate(records: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
         "schema_version": SCHEMA_VERSION,
         "records": total,
         "ring_records": ring_records,
+        "annotations": annotations,
         "sources": sources,
         "tenants": tenants,
         "groups": table,

@@ -81,6 +81,13 @@ BENCH_METRICS = (
     "config_hlo.findings_max_per_program",
     "config_hlo.fingerprint_flips",
     "config_hlo.top_target_bytes",
+    "config_calibration.recompiles_after_warmup",
+    "config_calibration.harvest_reconciled",
+    "config_calibration.unsolved",
+    "config_calibration.promotions",
+    "config_calibration.rollbacks",
+    "config_calibration.route_table_version",
+    "config_calibration.win_rate",
 )
 
 #: Loadgen-report metrics lifted into a ledger row. The
@@ -97,6 +104,7 @@ LOADGEN_METRICS = (
     "errors",
     "solved",
     "dropped_arrivals",
+    "route_table_version",
     "tenant_fairness.tenants",
     "tenant_fairness.quiet_p99_ratio",
     "tenant_fairness.victim_shed_share",

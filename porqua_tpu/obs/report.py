@@ -425,6 +425,60 @@ def slo_section(events: Sequence[Dict[str, Any]],
     return "\n".join(lines)
 
 
+#: Event kinds rendered on the calibration timeline (the closed
+#: route-calibration loop's lifecycle — porqua_tpu/obs/calibrate.py).
+_CALIBRATION_KINDS = ("route_reseed", "route_rollback",
+                      "solver_routes_seeded")
+
+
+def calibration_section(events: Sequence[Dict[str, Any]],
+                        max_shown: int = 40) -> str:
+    """The route-calibration timeline: every ``route_reseed`` state
+    transition (candidate → promoted → settled, or abandoned) with its
+    table version and changed cells, every ``route_rollback`` with the
+    breach that caused it, plus offline ``solver_routes_seeded``
+    bootstraps — the view that answers "who changed the route table,
+    when, and on what evidence"."""
+    rows = [e for e in events if e.get("kind") in _CALIBRATION_KINDS]
+    if not rows:
+        return ("calibration timeline: (no route_reseed / "
+                "route_rollback events)")
+    rows = sorted(rows, key=lambda e: float(e.get("t", 0.0)))
+    t0 = float(rows[0].get("t", 0.0))
+    lines = ["calibration timeline"]
+    promoted = sum(1 for e in rows if e.get("kind") == "route_reseed"
+                   and e.get("state") == "promoted")
+    rolled = sum(1 for e in rows if e.get("kind") == "route_rollback")
+    for e in rows[-max_shown:]:
+        dt = float(e.get("t", 0.0)) - t0
+        kind = e.get("kind")
+        if kind == "route_reseed":
+            diff = e.get("diff") or {}
+            cells = ", ".join(
+                f"{c}:{d.get('old', '?')}->{d.get('new', '?')}"
+                for c, d in sorted(diff.items())) or "(no cells)"
+            lines.append(
+                f"  +{dt:8.2f}s  route_reseed   "
+                f"{e.get('state', '?'):<9} v{e.get('table_version', 0)}"
+                f"  {cells}")
+        elif kind == "route_rollback":
+            lines.append(
+                f"  +{dt:8.2f}s  route_rollback v"
+                f"{e.get('table_version', 0)}  "
+                f"[{e.get('reason', '?')}]")
+        else:
+            routes = e.get("routes") or {}
+            lines.append(
+                f"  +{dt:8.2f}s  routes_seeded  offline   "
+                + (", ".join(f"{c}:{m}"
+                             for c, m in sorted(routes.items()))
+                   or "(none)"))
+    lines.append(
+        f"  promotions: {promoted} / rollbacks: {rolled}"
+        + ("  !! ROLLED BACK" if rolled else ""))
+    return "\n".join(lines)
+
+
 def fleet_section(report: Dict[str, Any]) -> str:
     """The fleet view of a ``scripts/fleet_loadgen.py`` run: the
     per-worker throughput/latency table, the reconciliation verdict,
@@ -582,6 +636,7 @@ def render_report(trace: Any = None,
         sections.append(convergence_section(events))
         sections.append(faults_section(events))
         sections.append(slo_section(events))
+        sections.append(calibration_section(events))
         sections.append(events_section(events))
     if harvest is not None:
         sections.append(harvest_section(harvest))

@@ -195,7 +195,8 @@ class MicroBatcher:
                  admission=None,
                  tenant_weights=None,
                  tenant_slos=None,
-                 router=None) -> None:
+                 router=None,
+                 calibrator=None) -> None:
         self.cache = cache
         # Optional porqua_tpu.serve.routing.SolverRouter: per-(bucket,
         # eps) backend choice at dispatch time, resolved host-side to
@@ -231,6 +232,12 @@ class MicroBatcher:
         self.slo = slo
         self.flight = flight
         self.anomaly = anomaly
+        # Optional porqua_tpu.obs.calibrate.Calibrator: the closed
+        # calibration loop. Fed every retired harvest record (and, via
+        # maybe_shadow, every shadow comparison), ticked on the same
+        # clock gate as the rest of the plane — host-side dispatch
+        # selection only (contract GC111).
+        self.calibrator = calibrator
         self.max_batch = int(max_batch)
         self.max_wait_s = float(max_wait_ms) * 1e-3
         self.queue: "queue.Queue[Optional[SolveRequest]]" = queue.Queue(
@@ -488,8 +495,9 @@ class MicroBatcher:
         if self.router is not None:
             self.router.maybe_shadow(
                 bucket, slots, dtype, self.health.device(), qp, x0, y0,
-                method, {"status": status, "iters": iters, "obj": obj},
-                live, self.harvest)
+                method, {"status": status, "iters": iters, "obj": obj,
+                         "solve_s": solve_s},
+                live, self.harvest, calibrator=self.calibrator)
         self._plane_tick()
 
     def _plane_tick(self) -> None:
@@ -507,6 +515,11 @@ class MicroBatcher:
             self.slo.maybe_evaluate()
         if self.tenant_slos is not None:
             self.tenant_slos.maybe_evaluate()
+        if self.calibrator is not None:
+            # The closed loop's heartbeat: fold nothing here (evidence
+            # streams in per record), just advance the promotion state
+            # machine on its own clock gate.
+            self.calibrator.maybe_tick()
 
     #: Harvest-record provenance tag (the continuous batcher overrides).
     harvest_source = "serve"
@@ -561,7 +574,8 @@ class MicroBatcher:
             # must carry the backend that produced it, not the
             # service default).
             params = self.cache.params
-        if self.harvest is not None or self.flight is not None:
+        if (self.harvest is not None or self.flight is not None
+                or self.calibrator is not None):
             ring = None
             if rp is not None:
                 ring = ring_history(rp[i], rd[i], rr[i], int(iters[i]),
@@ -587,6 +601,11 @@ class MicroBatcher:
                 # ring — an incident bundle then carries the recent
                 # solve history even when no harvest sink is wired.
                 self.flight.record_solve(rec)
+            if self.calibrator is not None:
+                # And the same record again into the calibration
+                # loop's rolling evidence (the routed half; shadow
+                # comparisons arrive through maybe_shadow).
+                self.calibrator.observe(rec)
         r.future.set_result(SolveResult(
             # Copy: the row slice is a view whose .base is the whole
             # (slots, n) batch array — a caller retaining results

@@ -765,6 +765,13 @@ def run_loadgen(requests: List[CanonicalQP],
                 "harvest_records_measured": sink.records - harvest_records0,
                 "harvest_write_failures": sink.write_failures,
             })
+        if getattr(service, "router", None) is not None:
+            # Routing plane: persist the versioned route table the run
+            # ended on (the ledger trends the version across runs; a
+            # calibration rollback bumps it, never reuses it).
+            rsnap = service.router.snapshot()
+            obs_fields["route_table_version"] = rsnap["table_version"]
+            obs_fields["route_table"] = rsnap["table"]
         n = len(requests)
         return {
             **obs_fields,

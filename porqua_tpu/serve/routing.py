@@ -36,6 +36,17 @@ it never touches a traced program):
 
 ``force(method)`` pins every decision to one backend (chaos drills,
 manual rollback); ``force(None)`` returns to the table.
+
+The table itself is **versioned**: every swap — a
+:meth:`seed_from_aggregate` bootstrap, a :meth:`set_table` promotion
+from the live calibration plane (:mod:`porqua_tpu.obs.calibrate`), a
+rollback — bumps the monotonic ``route_table_version`` counter.
+Versions are never reused: a rollback to a previous table is a NEW
+version carrying old content, so the audit chain in the harvest
+warehouse replays linearly to the active table. ``shadow_budget_per_
+tick`` caps how many shadow re-solves may run between calibration
+ticks (excess dispatches are deferred and counted ``shadow_deferred``)
+so evidence gathering cannot tax dispatch latency unboundedly.
 """
 
 from __future__ import annotations
@@ -75,13 +86,17 @@ class SolverRouter:
                  events=None,
                  cost_log=None,
                  shadow_rate: float = 0.0,
-                 shadow_seed: int = 0) -> None:
+                 shadow_seed: int = 0,
+                 shadow_budget_per_tick: Optional[int] = None) -> None:
         if params.method not in METHODS:
             raise ValueError(
                 f"unknown method {params.method!r}; expected one of "
                 f"{METHODS}")
         if not 0.0 <= float(shadow_rate) <= 1.0:
             raise ValueError("shadow_rate must be in [0, 1]")
+        if shadow_budget_per_tick is not None \
+                and int(shadow_budget_per_tick) < 0:
+            raise ValueError("shadow_budget_per_tick must be >= 0")
         self.default_method = params.method
         self.metrics = metrics
         self.events = events
@@ -94,14 +109,20 @@ class SolverRouter:
                                cost_log=cost_log)
             for m in METHODS}
         self.shadow_rate = float(shadow_rate)
+        self.shadow_budget_per_tick = (
+            None if shadow_budget_per_tick is None
+            else int(shadow_budget_per_tick))
         self._shadow_rng = random.Random(shadow_seed)
         self._lock = tsan.lock("SolverRouter")
         # guarded-by: self._lock
         self._table: Dict[Tuple[str, float], str] = {}
+        self._table_version = 0
         self._force: Optional[str] = None
         self._decisions: Dict[str, int] = {m: 0 for m in METHODS}
         self._shadow_solves = 0
         self._shadow_failures = 0
+        self._shadow_deferred = 0
+        self._shadow_in_tick = 0
 
     # -- identity ----------------------------------------------------
 
@@ -213,10 +234,49 @@ class SolverRouter:
                 winner = min(cell.items(), key=score)[0]
                 self._table[key] = winner
                 written[f"{key[0]}@{key[1]:.0e}"] = winner
+            if written:
+                self._table_version += 1
         if self.events is not None and written:
             self.events.emit("solver_routes_seeded", "info",
                              routes=dict(sorted(written.items())))
         return written
+
+    # -- versioned table swap ----------------------------------------
+
+    @property
+    def table_version(self) -> int:
+        """Monotonic route-table version: 0 at birth, +1 on every
+        swap (seed, promotion, rollback). Never reused — a rollback to
+        prior content is a NEW version, so the calibration audit chain
+        replays linearly."""
+        with self._lock:
+            return self._table_version
+
+    def table(self) -> Dict[Tuple[str, float], str]:
+        """A copy of the active route table keyed ``(label, eps)`` —
+        what the calibrator diffs candidates against and stashes as
+        the rollback target before a promotion."""
+        with self._lock:
+            return dict(self._table)
+
+    def set_table(self, table: Dict[Tuple[str, float], str]) -> int:
+        """Atomically replace the whole route table and bump the
+        version; returns the new version. The calibration plane's
+        single mutation point for both promotion and rollback —
+        callers own eventing/auditing (the router stays a dumb,
+        versioned switch). Entries must name known backends; the
+        prewarmed-both-ladders invariant makes any swap 0-recompile."""
+        clean: Dict[Tuple[str, float], str] = {}
+        for (label, eps), method in table.items():
+            if method not in METHODS:
+                raise ValueError(
+                    f"unknown method {method!r} for cell "
+                    f"{label}@{eps}; expected one of {METHODS}")
+            clean[(str(label), float(eps))] = method
+        with self._lock:
+            self._table = clean
+            self._table_version += 1
+            return self._table_version
 
     # -- prewarm -----------------------------------------------------
 
@@ -238,19 +298,33 @@ class SolverRouter:
 
     def maybe_shadow(self, bucket: Bucket, slots: int, dtype, device,
                      qp, x0, y0, method: str, primary: Dict[str, Any],
-                     live, harvest) -> bool:
+                     live, harvest, calibrator=None) -> bool:
         """Sampled re-solve of an already-served batch on the other
         backend; per-live-lane delta records into ``harvest``. Runs on
         the dispatch thread strictly AFTER the primary futures
         resolved — shadow work may add throughput cost (that is the
-        price of fresh tables) but never request latency. Best-effort:
-        any failure counts ``shadow_failures`` and is swallowed (a
-        broken shadow must not fail served traffic). Returns whether a
-        shadow ran."""
+        price of fresh tables) but never request latency. At most
+        ``shadow_budget_per_tick`` shadows run between
+        :meth:`reset_shadow_budget` calls (the calibration tick);
+        sampled dispatches over budget are deferred and counted
+        ``shadow_deferred``. Best-effort: any failure counts
+        ``shadow_failures`` and is swallowed (a broken shadow must not
+        fail served traffic). Each shadow record is also fed to the
+        live ``calibrator`` when one is wired — the evidence stream
+        the route table re-seeds itself from. Returns whether a shadow
+        ran."""
         if harvest is None or self.shadow_rate <= 0.0:
             return False
         with self._lock:
             fire = self._shadow_rng.random() < self.shadow_rate
+            if fire and self.shadow_budget_per_tick is not None:
+                if self._shadow_in_tick >= self.shadow_budget_per_tick:
+                    self._shadow_deferred += 1
+                    fire = False
+                else:
+                    self._shadow_in_tick += 1
+            elif fire:
+                self._shadow_in_tick += 1
         if not fire:
             return False
         alt = "pdhg" if method == "admm" else "admm"
@@ -274,8 +348,9 @@ class SolverRouter:
                     error=f"{type(exc).__name__}: {exc}")
             return False
         params_alt = self.caches[alt].params
+        primary_solve_s = primary.get("solve_s")
         for i, r in enumerate(live):
-            harvest.emit(solve_record(
+            rec = solve_record(
                 "serve.shadow", r.n_orig, r.m_orig, int(status[i]),
                 int(iters[i]), float(prim[i]), float(dual[i]),
                 float(obj[i]), params=params_alt,
@@ -288,12 +363,24 @@ class SolverRouter:
                 delta_iters=int(iters[i]) - int(primary["iters"][i]),
                 delta_obj=float(obj[i]) - float(primary["obj"][i]),
                 agree=bool(int(status[i]) == int(primary["status"][i])),
-            ))
+            )
+            if primary_solve_s is not None:
+                rec["delta_solve_s"] = solve_s - float(primary_solve_s)
+            harvest.emit(rec)
+            if calibrator is not None:
+                calibrator.observe(rec)
         with self._lock:
             self._shadow_solves += 1
         if self.metrics is not None:
             self.metrics.inc("shadow_solves")
         return True
+
+    def reset_shadow_budget(self) -> None:
+        """Open a fresh shadow-budget window (the calibration tick
+        calls this; without a calibrator a budget-capped router keeps
+        one window for its whole life, which is still a hard bound)."""
+        with self._lock:
+            self._shadow_in_tick = 0
 
     # -- readers -----------------------------------------------------
 
@@ -311,8 +398,11 @@ class SolverRouter:
                 "forced": self._force,
                 "table": {f"{b}@{eps:.0e}": m
                           for (b, eps), m in sorted(self._table.items())},
+                "table_version": self._table_version,
                 "decisions": dict(self._decisions),
                 "shadow_rate": self.shadow_rate,
+                "shadow_budget_per_tick": self.shadow_budget_per_tick,
                 "shadow_solves": self._shadow_solves,
                 "shadow_failures": self._shadow_failures,
+                "shadow_deferred": self._shadow_deferred,
             }

@@ -291,6 +291,7 @@ class SolveService:
                  tenant_weights=None,
                  tenant_slos=None,
                  router=None,
+                 calibrator=None,
                  **health_kwargs) -> None:
         self.params = params
         self.continuous = bool(continuous)
@@ -317,7 +318,8 @@ class SolveService:
         # creates one, so alerts and triggers always have somewhere to
         # land.
         if obs is None and (slo is not None or flight is not None
-                            or anomaly is not None):
+                            or anomaly is not None
+                            or calibrator is not None):
             from porqua_tpu.obs import Observability
 
             obs = Observability()
@@ -410,6 +412,20 @@ class SolveService:
         self.cache = cache
         if flight is not None:
             flight.attach(cache=self.cache)
+        # Optional porqua_tpu.obs.calibrate.Calibrator: the closed
+        # calibration loop — live route re-seeding from the shadow
+        # stream with guarded promotion and auto-rollback. Requires a
+        # router (there is no table to calibrate otherwise); late-binds
+        # this service's planes so its evidence, events, audit records
+        # and guard signals all land where the rest of the stack's do.
+        self.calibrator = calibrator
+        if calibrator is not None:
+            if router is None:
+                raise ValueError(
+                    "calibrator= requires router= (the calibration "
+                    "loop re-seeds the router's route table)")
+            calibrator.bind(router=router, harvest=harvest,
+                            events=events, anomaly=anomaly, slo=slo)
         # Optional request-level recovery layer
         # (porqua_tpu.resilience.retry): retry with backoff + jitter,
         # idempotent resubmission by request id, deadline-aware
@@ -433,7 +449,8 @@ class SolveService:
             obs=obs, harvest=harvest, profiler=profiler,
             slo=slo, flight=flight, anomaly=anomaly,
             admission=self.admission, tenant_weights=tenant_weights,
-            tenant_slos=tenant_slos, router=router)
+            tenant_slos=tenant_slos, router=router,
+            calibrator=calibrator)
         if self.continuous:
             # Continuous batching: cohorts step one segment at a time,
             # retire lanes the boundary they converge (or hit the
@@ -525,6 +542,11 @@ class SolveService:
         if self.slo is not None:
             self.slo.maybe_evaluate()
             out.update(self.slo.gauges())
+        if self.calibrator is not None:
+            # Calibration-plane gauges: route-table version, age of
+            # the last reseed, promotion/rollback totals, the state-
+            # machine position — the closed loop's scrape surface.
+            out.update(self.calibrator.gauges())
         for key, value in self.vitals().items():
             if key != "t":
                 out[f"vitals_{key}"] = value
@@ -563,6 +585,8 @@ class SolveService:
             out.update(self.flight.counters())
         if self.anomaly is not None:
             out.update(self.anomaly.counters())
+        if self.calibrator is not None:
+            out.update(self.calibrator.counters())
         return out
 
     def _health_payload(self) -> dict:
@@ -603,6 +627,11 @@ class SolveService:
             # without scraping and parsing the full exposition.
             self.slo.maybe_evaluate()
             payload["slo"] = self.slo.status()
+        if self.calibrator is not None:
+            # The calibration loop's position in one endpoint: state,
+            # table version, candidate cells, counters, knobs — the
+            # smoke/chaos cells assert promotion and rollback here.
+            payload["calibration"] = self.calibrator.status()
         tenants = snap.get("tenants")
         if tenants:
             # The tenant axis in one endpoint: per-tenant counters +

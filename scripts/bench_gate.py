@@ -159,6 +159,27 @@ RULES = [
      "eq", 1, "invariant"),
     ("routing_unsolved", "config_routing.unsolved",
      "eq", 0, "invariant"),
+    # calibration_*: the closed-loop config (cold-start empty table,
+    # live shadow evidence promotes the winning backend through
+    # candidate/canary/guard on a stepped clock). Promotion must
+    # actually happen (promotions == 1) with no auto-rollback, the
+    # versioned table swap must cost zero recompiles (prewarmed both
+    # ladders), the measured routed phase reconciles exactly, and the
+    # warehouse audit chain must replay to the live table/version.
+    ("calibration_recompiles",
+     "config_calibration.recompiles_after_warmup",
+     "eq", 0, "invariant"),
+    ("calibration_reconciliation",
+     "config_calibration.harvest_reconciled",
+     "eq", 1, "invariant"),
+    ("calibration_unsolved", "config_calibration.unsolved",
+     "eq", 0, "invariant"),
+    ("calibration_promoted", "config_calibration.promotions",
+     "eq", 1, "invariant"),
+    ("calibration_no_rollback", "config_calibration.rollbacks",
+     "eq", 0, "invariant"),
+    ("calibration_audit_replay", "config_calibration.audit_replay_ok",
+     "eq", 1, "invariant"),
     # -- post-lowering HLO lint (config_hlo) ----------------------------
     # The hlolint harvest (analysis/hlo.bench_hlo_part — emitted by
     # bench.py's config_hlo part or hlolint_report.py --bench-part).
@@ -440,7 +461,10 @@ def _selftest() -> int:
     # not carry — exercised in their own cell below).
     _part_rules = {"pdhg_te_band", "sketch_off_identity",
                    "routing_recompiles", "routing_reconciliation",
-                   "routing_unsolved", "hlo_findings_total",
+                   "routing_unsolved", "calibration_recompiles",
+                   "calibration_reconciliation", "calibration_unsolved",
+                   "calibration_promoted", "calibration_no_rollback",
+                   "calibration_audit_replay", "hlo_findings_total",
                    "hlo_findings_per_program", "hlo_fingerprint_flips",
                    "hlo_program_coverage", "hlo_top_target_bytes"}
     assert all(c["class"] == "fairness" or c["name"] in _part_rules
@@ -529,6 +553,15 @@ def _selftest() -> int:
     routed_good["config_routing"] = {"recompiles_after_warmup": 0,
                                      "harvest_reconciled": 1,
                                      "unsolved": 0}
+    # Closed-loop calibration cell: a clean cold-start run (one
+    # promotion, no rollback, zero recompiles through the table swap,
+    # audit chain replaying to the live table) passes every
+    # calibration rule; a run that recompiled, rolled back, never
+    # promoted, or whose audit chain diverged fails exactly them.
+    routed_good["config_calibration"] = {
+        "recompiles_after_warmup": 0, "harvest_reconciled": 1,
+        "unsolved": 0, "promotions": 1, "rollbacks": 0,
+        "route_table_version": 1, "audit_replay_ok": 1}
     v_routed = check_payload(base, routed_good)
     assert v_routed["ok"], v_routed["failed"]
     routed_bad = json.loads(json.dumps(routed_good))
@@ -537,11 +570,18 @@ def _selftest() -> int:
     routed_bad["config_routing"] = {"recompiles_after_warmup": 3,
                                     "harvest_reconciled": 0,
                                     "unsolved": 2}
+    routed_bad["config_calibration"] = {
+        "recompiles_after_warmup": 2, "harvest_reconciled": 0,
+        "unsolved": 1, "promotions": 0, "rollbacks": 1,
+        "route_table_version": 2, "audit_replay_ok": 0}
     v_routed_bad = check_payload(base, routed_bad)
     assert not v_routed_bad["ok"]
     for name in ("pdhg_te_band", "sketch_off_identity",
                  "routing_recompiles", "routing_reconciliation",
-                 "routing_unsolved"):
+                 "routing_unsolved", "calibration_recompiles",
+                 "calibration_reconciliation", "calibration_unsolved",
+                 "calibration_promoted", "calibration_no_rollback",
+                 "calibration_audit_replay"):
         assert name in v_routed_bad["failed"], v_routed_bad["failed"]
 
     # HLO cells: a fresh harvest at the committed floor (zero

@@ -138,6 +138,16 @@ TENANT_CELLS = ("noisy_neighbor", "tenant_feed_corrupt")
 #: fault in production).
 ROUTE_CELLS = ("solver_route_flap",)
 
+#: Closed-loop calibration cells (scripts/calibration_smoke.py
+#: implements them; classic AND continuous): ``calibration_poison`` —
+#: every request corrupted at the ``data.feed`` seam, so every record
+#: the live calibrator sees is rejected at the evidence gate, the loop
+#: never promotes, and zero poisoned requests resolve with an answer;
+#: ``calibration_rollback`` — a promoted-then-drifting route table
+#: must auto-revert (version bumped, never reused) with exactly one
+#: ``route_rollback``-triggered incident bundle.
+CALIBRATION_CELLS = ("calibration_poison", "calibration_rollback")
+
 #: The CI smoke (`--selftest`): one raising seam, one corruption seam
 #: riding the validation gate, and one continuous-mode run.
 SELFTEST = (("device_lost", "classic"), ("nan_lanes", "classic"),
@@ -596,10 +606,12 @@ def main(argv=None) -> int:
         cells = list(SELFTEST)
     else:
         names = (list(SCENARIOS) + list(TENANT_CELLS) + list(ROUTE_CELLS)
+                 + list(CALIBRATION_CELLS)
                  if args.scenarios is None
                  else [s.strip() for s in args.scenarios.split(",") if s])
         modes = [m.strip() for m in args.modes.split(",") if m]
-        known = list(SCENARIOS) + list(TENANT_CELLS) + list(ROUTE_CELLS)
+        known = (list(SCENARIOS) + list(TENANT_CELLS) + list(ROUTE_CELLS)
+                 + list(CALIBRATION_CELLS))
         for s in names:
             if s not in known:
                 ap.error(f"unknown scenario {s!r} (known: "
@@ -636,6 +648,18 @@ def main(argv=None) -> int:
             results.append(run_route_flap_cell(
                 mode, args.seed, qps, refs, params, ladder,
                 verbose=True))
+            continue
+        if name in CALIBRATION_CELLS:
+            # Closed-loop calibration cells: own service per cell (the
+            # calibrator/anomaly/flight wiring is construction-time),
+            # implemented in scripts/calibration_smoke.py.
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            from calibration_smoke import run_calibration_cell
+
+            verdict = run_calibration_cell(name, mode=mode,
+                                           seed=args.seed, verbose=True)
+            verdict["scenario"] = verdict.pop("cell")
+            results.append(verdict)
             continue
         results.append(run_scenario(name, mode, args.seed, qps, refs,
                                     params, ladder, cache, verbose=True))

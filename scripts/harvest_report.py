@@ -124,6 +124,59 @@ def _render_solver_table(agg: Dict[str, Any]) -> List[str]:
     return lines
 
 
+def render_calibration_table(records: List[Dict[str, Any]]) -> List[str]:
+    """The calibration-evidence table: the closed-loop audit chain
+    (``source="calibration.audit"`` records the live
+    :class:`porqua_tpu.obs.Calibrator` lands in the warehouse at every
+    candidate/promote/rollback). Each changed cell renders with the
+    shadow win-rate and sample count the promotion was gated on, the
+    route flip, and the table version at the action; the final line is
+    the active table the chain replays to. Empty when the dataset
+    carries no audit records (every pre-calibration dataset). Plain
+    dict reads — no JAX, same bar as the rest of the report."""
+    audits = sorted((r for r in records
+                     if r.get("source") == "calibration.audit"),
+                    key=lambda r: (int(r.get("table_version", 0)),
+                                   float(r.get("t", 0.0))))
+    if not audits:
+        return []
+    lines = [
+        "calibration audit (closed-loop route re-seeding; win% = "
+        "shadow win rate gating the action):",
+        f"{'action':<10} {'version':>7} {'cell':<16} {'route':<12} "
+        f"{'samples':>7} {'win%':>5}  reason",
+    ]
+    for rec in audits:
+        action = rec.get("action", "?")
+        version = int(rec.get("table_version", 0))
+        reason = rec.get("reason", "")
+        diff = rec.get("diff") or {}
+        if not diff:
+            lines.append(f"{action:<10} {version:>7} {'-':<16} "
+                         f"{'-':<12} {'-':>7} {'-':>5}  {reason}")
+            continue
+        for cell, d in sorted(diff.items()):
+            shadow = (d.get("evidence") or {}).get("shadow") or {}
+            samples = shadow.get("samples")
+            win = shadow.get("win_rate")
+            route = f"{d.get('old', '?')}->{d.get('new', '?')}"
+            lines.append(
+                f"{action:<10} {version:>7} {cell:<16} {route:<12} "
+                f"{(str(samples) if samples is not None else '-'):>7} "
+                f"{(f'{win * 100:.0f}' if win is not None else '-'):>5}"
+                f"  {reason}")
+    swaps = [r for r in audits
+             if r.get("action") in ("promote", "rollback")]
+    if swaps:
+        last = swaps[-1]
+        table = ", ".join(
+            f"{c}:{m}"
+            for c, m in sorted((last.get("table") or {}).items()))
+        lines.append(f"active table v{int(last.get('table_version', 0))}"
+                     f": {table or '(empty)'}")
+    return lines
+
+
 def _selftest() -> int:
     from porqua_tpu.obs.harvest import (
         HarvestSink, aggregate, load_harvest, solve_record)
@@ -213,6 +266,37 @@ def _selftest() -> int:
     pdhg_row = next(ln for ln in text3.splitlines()
                     if " pdhg " in f" {ln} " and "32x4" in ln)
     assert " 16 " in pdhg_row and " 0 " in pdhg_row, pdhg_row
+    # A dataset without audit records renders no calibration section.
+    assert render_calibration_table(routed) == [], "unexpected audit"
+
+    # Calibration audit chain: a promote (with the evidence diff the
+    # gate held — shadow win-rate + sample counts) and a rollback.
+    # Audit records carry no solve fields, so the aggregate must count
+    # them as annotations (never a group) while the calibration table
+    # renders the chain and the active table it replays to.
+    audited = list(routed)
+    audited.append({
+        "v": 1, "source": "calibration.audit", "t": 10.0,
+        "action": "promote", "table_version": 1,
+        "table": {"32x4@1e-03": "pdhg"}, "prior_table": {},
+        "diff": {"32x4@1e-03": {
+            "old": "admm", "new": "pdhg",
+            "evidence": {"shadow": {"samples": 16, "wins": 15,
+                                    "win_rate": 0.9375}}}}})
+    audited.append({
+        "v": 1, "source": "calibration.audit", "t": 20.0,
+        "action": "rollback", "table_version": 2, "table": {},
+        "prior_table": {"32x4@1e-03": "pdhg"}, "diff": {},
+        "reason": "anomaly_fired +1 since promotion"})
+    agg4 = aggregate(audited)
+    assert agg4["annotations"] == 2, agg4["annotations"]
+    assert agg4["sources"].get("calibration.audit") == 2, agg4["sources"]
+    text4 = "\n".join(render_calibration_table(audited))
+    for needle in ("calibration audit", "promote", "32x4@1e-03",
+                   "admm->pdhg", " 16 ", "94", "rollback",
+                   "anomaly_fired +1 since promotion",
+                   "active table v2: (empty)"):
+        assert needle in text4, f"selftest: {needle!r} missing:\n{text4}"
 
     print(text)
     print("\nharvest_report selftest: ok")
@@ -247,6 +331,10 @@ def main() -> int:
         print(json.dumps(agg, indent=1))
     else:
         print(render_table(agg))
+        cal_lines = render_calibration_table(records)
+        if cal_lines:
+            print()
+            print("\n".join(cal_lines))
     if args.out:
         with open(args.out, "w") as f:
             json.dump(agg, f, indent=1)

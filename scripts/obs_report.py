@@ -106,6 +106,19 @@ def _selftest() -> int:
                     rule="fast", state="resolved", burn_short=0.2,
                     burn_long=3.1, threshold=14.4, short_s=300.0,
                     long_s=3600.0, rule_severity="page")
+    # Calibration timeline: the closed loop's full lifecycle — a
+    # candidate promoted through canary, then guard-breached and
+    # rolled back — the calibration_section must render with versions
+    # and changed cells.
+    diff = {"32x8@1e-03": {"old": "admm", "new": "pdhg"}}
+    obs.events.emit("route_reseed", "info", state="candidate",
+                    table_version=0, n_cells=1, diff=diff)
+    obs.events.emit("route_reseed", "info", state="promoted",
+                    table_version=1, n_cells=1, diff=diff,
+                    table={"32x8@1e-03": "pdhg"})
+    obs.events.emit("route_rollback", "error",
+                    reason="anomaly_fired +1 since promotion",
+                    table_version=2, restored_table={}, diff=diff)
 
     trace = obs.spans.chrome_trace()
     cov = coverage_stats(trace)
@@ -284,6 +297,13 @@ def _selftest() -> int:
                    "availability/fast -> resolved",
                    "anomaly    32x8 -> firing",
                    "alerts: 1 fired / 1 resolved",
+                   # The calibration timeline: candidate -> promoted
+                   # -> rolled back, with versions and changed cells.
+                   "calibration timeline",
+                   "candidate",
+                   "promoted  v1  32x8@1e-03:admm->pdhg",
+                   "route_rollback v2  [anomaly_fired +1",
+                   "promotions: 1 / rollbacks: 1  !! ROLLED BACK",
                    # The device cost / memory section: per-bucket peak
                    # memory + the measured-vs-model drift table.
                    "device cost / memory (2 CostRecords)",

@@ -59,7 +59,14 @@ routing-identity contract (both solver backends' programs carry the
 GC101-103 proofs, and a live SolverRouter — a harvest-seeded route
 table consulted per bucket, a force() flip, a snapshot — leaves the
 solve/serve jaxprs of BOTH backends string-identical: routing picks
-which compiled program runs, it never touches a traced one). With
+which compiled program runs, it never touches a traced one), and the
+GC111 calibration-identity contract (the closed calibration loop
+fully exercised on a stepped clock — shadow evidence folded with a
+poison record rejected, a candidate gated into canary, a promotion
+swapping the versioned route table, a guard breach auto-rolled back,
+the audit chain replayed — leaves both backends' solve/serve jaxprs
+string-identical: calibration only ever picks which prewarmed
+executable runs). With
 ``--hlo`` (or a ``--select`` naming any GC20x rule) the post-lowering
 plane runs too: :mod:`porqua_tpu.analysis.hlo` compiles every entry
 point via ``jit(...).lower(...).compile()`` and
@@ -151,7 +158,8 @@ def main(argv=None) -> int:
     if not args.no_contracts and (
             rules is None or rules & {"GC101", "GC102", "GC103", "GC104",
                                       "GC105", "GC106", "GC107",
-                                      "GC108", "GC109", "GC110"}):
+                                      "GC108", "GC109", "GC110",
+                                      "GC111"}):
         try:
             import jax
 

@@ -111,6 +111,22 @@ else
     fail=1
 fi
 
+# calibration smoke: the closed-loop route-calibration drills against
+# a live SolveService on a stepped clock (no wall-clock waits) — a
+# cold-start promotion (candidate -> canary -> versioned table swap at
+# zero recompiles, audit chain replaying to the active table), a
+# poisoned feed that must be rejected at the evidence gate and never
+# promote, and a promoted-then-drifting table that must auto-rollback
+# with exactly one route_rollback incident bundle (README "Solver
+# routing"). Both cells also run in chaos_suite.py's full matrix.
+if out=$(timeout 600 env JAX_PLATFORMS=cpu python scripts/calibration_smoke.py --selftest 2>&1); then
+    echo "OK   calibration_smoke: $(echo "$out" | tail -1)"
+else
+    echo "FAIL calibration_smoke:"
+    echo "$out"
+    fail=1
+fi
+
 # fleet_loadgen: the federation plane — a no-JAX collector unit pass
 # (merge / reconciliation / liveness / rollup bounds / namespacing /
 # ladder refusal) plus a real 2-worker ~10 s mini-soak on XLA-CPU

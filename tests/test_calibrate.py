@@ -1,0 +1,339 @@
+"""Closed-loop route calibration (porqua_tpu.obs.calibrate).
+
+Host-side contracts only — no compiles, no wall-clock sleeps: every
+time-dependent path steps a FaultClock. Pins the staged promotion
+state machine (idle -> canary dwell -> versioned promote -> guard ->
+settle), the poisoned-evidence rejection gate, the guard-breach
+auto-rollback (version bumped, NEVER reused; cooldown refuses an
+immediate re-candidate; exactly one ``route_rollback`` event), the
+audit chain replaying to the active table, and the deliberate
+tenant-blindness of the evidence pool (the calibrator can never build
+a per-tenant route table).
+"""
+
+import dataclasses
+import math
+
+import pytest
+
+from porqua_tpu.obs.calibrate import (CALIBRATION_AUDIT_SOURCE,
+                                      Calibrator, replay_audit)
+from porqua_tpu.obs.harvest import HarvestSink, solve_record
+from porqua_tpu.qp.solve import SolverParams
+from porqua_tpu.resilience.faults import FaultClock
+from porqua_tpu.serve import Bucket
+from porqua_tpu.serve.routing import SolverRouter
+
+PARAMS = SolverParams(max_iter=500, eps_abs=1e-5, eps_rel=1e-5,
+                      polish=False, check_interval=25)
+EPS = float(PARAMS.eps_abs)
+CELL = f"8x4@{EPS:.0e}"
+
+
+def _serve_rec(method, *, bucket="8x4", iters=40, solve_s=4e-3,
+               status=1, obj=0.1, tenant=None):
+    p = dataclasses.replace(PARAMS, method=method)
+    return solve_record("serve", 6, 2, status, iters, 1e-6, 1e-6, obj,
+                        params=p, bucket=bucket, solve_s=solve_s,
+                        tenant=tenant)
+
+
+def _shadow_rec(method="pdhg", *, shadow_of="admm", bucket="8x4",
+                iters=12, solve_s=1e-5, obj=0.1, agree=True,
+                delta_iters=-28, delta_solve_s=-4e-3, tenant=None):
+    p = dataclasses.replace(PARAMS, method=method)
+    rec = solve_record("serve.shadow", 6, 2, 1, iters, 1e-6, 1e-6, obj,
+                       params=p, bucket=bucket, solve_s=solve_s,
+                       tenant=tenant, shadow_of=shadow_of,
+                       delta_iters=delta_iters, delta_obj=0.0,
+                       agree=agree)
+    if delta_solve_s is not None:
+        rec["delta_solve_s"] = delta_solve_s
+    return rec
+
+
+def _offer_evidence(cal, n=6, tenant=None):
+    # Paired evidence: the incumbent (admm) served, pdhg shadowed it
+    # and won every comparison — the minimal promotable stream.
+    for _ in range(n):
+        assert cal.observe(_serve_rec("admm", tenant=tenant))
+        assert cal.observe(_shadow_rec(tenant=tenant))
+
+
+class _Events:
+    def __init__(self):
+        self.emitted = []
+
+    def emit(self, kind, severity, **fields):
+        self.emitted.append((kind, severity, fields))
+
+    def kinds(self, kind):
+        return [e for e in self.emitted if e[0] == kind]
+
+
+class _Anomaly:
+    def __init__(self):
+        self.fired = 0
+
+    def counters(self):
+        return {"anomalies_fired": self.fired}
+
+
+def _mk(clk, **kw):
+    router = SolverRouter(PARAMS)
+    sink = HarvestSink()
+    events = _Events()
+    anomaly = _Anomaly()
+    kw.setdefault("min_interval_s", 0.0)
+    kw.setdefault("min_samples", 4)
+    kw.setdefault("win_rate", 0.6)
+    kw.setdefault("canary_dwell_s", 5.0)
+    kw.setdefault("guard_window_s", 10.0)
+    cal = Calibrator(router=router, harvest=sink, events=events,
+                     anomaly=anomaly, clock=clk, **kw)
+    return cal, router, sink, events, anomaly
+
+
+# ---------------------------------------------------------------------------
+# validation + ingestion gates
+# ---------------------------------------------------------------------------
+
+def test_calibrator_validation():
+    with pytest.raises(ValueError, match="win_rate"):
+        Calibrator(win_rate=1.5)
+    with pytest.raises(ValueError, match="min_samples"):
+        Calibrator(min_samples=0)
+    with pytest.raises(ValueError, match="max_records_per_cell"):
+        Calibrator(max_records_per_cell=0)
+
+
+def test_observe_rejects_untrusted_evidence():
+    """The poison gate: records a corrupted feed produces (non-finite
+    objective, NaN deltas, missing cell coordinates, unknown backend)
+    are rejected — counted, never folded, never raised."""
+    cal = Calibrator(clock=FaultClock())
+    no_bucket = _serve_rec("admm")
+    del no_bucket["bucket"]                    # no cell coordinates
+    bad = [
+        _serve_rec("admm", obj=float("nan")),
+        no_bucket,
+        _shadow_rec(delta_iters=None),
+        _shadow_rec(delta_solve_s=float("inf")),
+    ]
+    rec = _serve_rec("admm")
+    rec["solver"] = "qpth"
+    bad.append(rec)
+    for r in bad:
+        assert cal.observe(r) is False
+    assert cal.observe(_serve_rec("admm")) is True
+    c = cal.counters()
+    assert c["calibration_rejected"] == len(bad)
+    assert c["calibration_observed"] == 1
+    assert cal.evidence()[CELL]["per_method"]["admm"]["count"] == 1
+
+
+def test_maybe_tick_clock_gate():
+    clk = FaultClock()
+    cal, _, _, _, _ = _mk(clk, min_interval_s=5.0)
+    assert cal.maybe_tick() is False          # inside the interval
+    clk.advance(6.0)
+    assert cal.maybe_tick() is True
+    assert cal.maybe_tick() is False          # gate re-arms
+    assert cal.counters()["calibration_ticks"] == 1
+
+
+# ---------------------------------------------------------------------------
+# staged promotion
+# ---------------------------------------------------------------------------
+
+def test_promotion_state_machine_and_audit_replay():
+    clk = FaultClock()
+    cal, router, sink, events, _ = _mk(clk)
+    assert router.table_version == 0
+    assert router.route(Bucket(8, 4, None)) == "admm"
+
+    _offer_evidence(cal)
+    cal.tick()                                 # idle -> canary
+    assert cal.status()["state"] == "canary"
+    assert cal.status()["candidate_cells"] == [CELL]
+    assert router.table_version == 0           # nothing swapped yet
+
+    clk.advance(6.0)
+    cal.tick()                                 # dwell held -> promote
+    assert cal.status()["state"] == "guard"
+    assert router.table_version == 1
+    assert router.snapshot()["table"] == {CELL: "pdhg"}
+    assert router.route(Bucket(8, 4, None)) == "pdhg"
+
+    clk.advance(11.0)
+    cal.tick()                                 # guard expires -> settle
+    c = cal.counters()
+    assert cal.status()["state"] == "idle"
+    assert c["calibration_promotions"] == 1
+    assert c["calibration_rollbacks"] == 0
+    assert c["calibration_settled"] == 1
+
+    # Every transition emitted route_reseed; the promote one carries
+    # the full evidence diff (per-method stats + the shadow win rate
+    # that gated it).
+    states = [e[2]["state"] for e in events.kinds("route_reseed")]
+    assert states == ["candidate", "promoted", "settled"]
+    diff = events.kinds("route_reseed")[1][2]["diff"][CELL]
+    assert diff["old"] == "admm" and diff["new"] == "pdhg"
+    assert diff["evidence"]["shadow"]["win_rate"] == 1.0
+
+    # Audit chain: landed in the warehouse AND replays to the active
+    # router state from the records alone.
+    audits = [r for r in sink.buffered()
+              if r["source"] == CALIBRATION_AUDIT_SOURCE]
+    assert [r["action"] for r in audits] == ["candidate", "promote"]
+    table, version = replay_audit(sink.buffered())
+    assert table == router.snapshot()["table"]
+    assert version == router.table_version == 1
+
+    # Gauges track the plane.
+    g = cal.gauges()
+    assert g["calibration_route_table_version"] == 1.0
+    assert g["calibration_promotions_total"] == 1.0
+    assert g["calibration_state"] == 0.0       # settled back to idle
+
+
+def test_insufficient_shadow_evidence_never_candidates():
+    """min_samples gates BOTH the per-backend evidence pool and the
+    winner's shadow comparisons — serve records alone can't promote."""
+    clk = FaultClock()
+    cal, router, _, _, _ = _mk(clk, min_samples=4)
+    for _ in range(6):
+        cal.observe(_serve_rec("admm"))
+        cal.observe(_serve_rec("pdhg", iters=12, solve_s=1e-5))
+    cal.tick()
+    assert cal.status()["state"] == "idle"
+    assert cal.counters()["calibration_candidates"] == 0
+    assert router.table_version == 0
+
+
+# ---------------------------------------------------------------------------
+# guard breach -> rollback
+# ---------------------------------------------------------------------------
+
+def test_rollback_bumps_version_never_reuses():
+    """The satellite regression: a guard breach reverts to the PRIOR
+    table under a NEW version (1 -> 2, never back to 0), fires exactly
+    one route_rollback event, drops the discredited evidence, and the
+    cooldown refuses an immediate re-candidate. The audit chain —
+    which only the calibrator wrote (cold-start flow; a
+    seed_from_aggregate bootstrap bumps the version with no audit
+    record, so chain-replay == router-state holds only here) — replays
+    to the active table."""
+    clk = FaultClock()
+    cal, router, sink, events, anomaly = _mk(clk)
+
+    _offer_evidence(cal)
+    cal.tick()
+    clk.advance(6.0)
+    cal.tick()
+    assert router.table_version == 1
+    assert cal.status()["state"] == "guard"
+
+    # Policy-induced drift inside the guard window: the anomaly
+    # detector fires -> breach -> auto-rollback.
+    anomaly.fired += 1
+    clk.advance(1.0)
+    cal.tick()
+    assert cal.status()["state"] == "idle"
+    assert cal.counters()["calibration_rollbacks"] == 1
+    assert router.table_version == 2           # bumped, NOT back to 0
+    assert router.snapshot()["table"] == {}    # prior (empty) content
+    assert router.route(Bucket(8, 4, None)) == "admm"
+
+    rollbacks = events.kinds("route_rollback")
+    assert len(rollbacks) == 1
+    assert rollbacks[0][1] == "error"
+    assert "anomaly_fired +1" in rollbacks[0][2]["reason"]
+
+    # Discredited evidence was dropped; fresh evidence inside the
+    # cooldown must not re-candidate.
+    assert cal.evidence() == {}
+    _offer_evidence(cal)
+    clk.advance(1.0)
+    cal.tick()
+    assert cal.status()["state"] == "idle"
+    assert cal.counters()["calibration_candidates"] == 1
+    assert cal.status()["cooldown_remaining_s"] > 0
+
+    # After the cooldown the same evidence may earn its way back.
+    clk.advance(cal.cooldown_s + 1.0)
+    cal.tick()
+    assert cal.counters()["calibration_candidates"] == 2
+
+    # The audit chain replays to the post-rollback state.
+    table, version = replay_audit(sink.buffered())
+    assert table == {} and version == 2
+    assert (table, version) == (router.snapshot()["table"],
+                                router.table_version)
+
+
+def test_replay_audit_rejects_nonmonotonic_versions():
+    def audit(action, version):
+        return {"v": 1, "source": CALIBRATION_AUDIT_SOURCE, "t": 0.0,
+                "action": action, "table_version": version,
+                "table": {CELL: "pdhg"}}
+
+    table, version = replay_audit(
+        [audit("promote", 1), {"source": "serve"}, audit("rollback", 2)])
+    assert version == 2
+    with pytest.raises(ValueError, match="not monotonic"):
+        replay_audit([audit("promote", 2), audit("rollback", 2)])
+
+
+# ---------------------------------------------------------------------------
+# tenancy
+# ---------------------------------------------------------------------------
+
+def test_evidence_pools_across_tenants():
+    """serve.shadow records carry tenant attribution, but the
+    calibrator deliberately ignores it: evidence for one (bucket, eps)
+    cell pools across tenants — 3 samples from each of two tenants
+    clear a min_samples=4 gate neither clears alone — and the
+    candidate table is global (cell-keyed, no tenant axis), so the
+    calibrator can never build a per-tenant route table."""
+    clk = FaultClock()
+    cal, router, _, _, _ = _mk(clk, min_samples=4)
+    for tenant in ("fund-a", "fund-b"):
+        _offer_evidence(cal, n=3, tenant=tenant)
+
+    ev = cal.evidence()
+    assert list(ev) == [CELL]                  # one pooled cell
+    assert ev[CELL]["per_method"]["admm"]["count"] == 6
+    assert ev[CELL]["shadow"]["pdhg"]["samples"] == 6
+
+    cal.tick()
+    assert cal.status()["state"] == "canary"
+    clk.advance(6.0)
+    cal.tick()
+    assert router.snapshot()["table"] == {CELL: "pdhg"}
+    assert not any("fund" in k for k in router.snapshot()["table"])
+
+
+# ---------------------------------------------------------------------------
+# plane resilience
+# ---------------------------------------------------------------------------
+
+def test_tick_errors_never_propagate():
+    """A broken calibration plane must not fail served traffic:
+    maybe_tick swallows and counts, never raises."""
+    class _BadRouter:
+        default_method = "admm"
+        table_version = 0
+
+        def reset_shadow_budget(self):
+            raise RuntimeError("boom")
+
+    clk = FaultClock()
+    cal = Calibrator(router=_BadRouter(), min_interval_s=0.0,
+                     clock=clk)
+    clk.advance(1.0)
+    assert cal.maybe_tick() is False
+    assert cal.counters()["calibration_tick_errors"] == 1
+    with pytest.raises(RuntimeError):
+        cal.tick()                             # gate-free entry raises

@@ -144,6 +144,102 @@ def test_seed_pools_across_tenants():
 
 
 # ---------------------------------------------------------------------------
+# versioned table swap (the calibration plane's mutation point)
+# ---------------------------------------------------------------------------
+
+def test_set_table_versioning():
+    router = SolverRouter(PARAMS)
+    assert router.table_version == 0
+    with pytest.raises(ValueError, match="unknown method"):
+        router.set_table({("8x4", EPS): "qpth"})
+    assert router.table_version == 0           # failed swap: no bump
+
+    assert router.set_table({("8x4", EPS): "pdhg"}) == 1
+    assert router.route(Bucket(8, 4, None)) == "pdhg"
+    assert router.table() == {("8x4", EPS): "pdhg"}
+    # A swap to identical content is still a NEW version — versions
+    # are never reused, so the audit chain replays linearly.
+    assert router.set_table({("8x4", EPS): "pdhg"}) == 2
+    assert router.set_table({}) == 3           # rollback-to-empty bumps
+    assert router.route(Bucket(8, 4, None)) == "admm"
+    # seed_from_aggregate shares the same version counter.
+    recs = (_records("8x4", "admm", 4, iters=100, solve_s=5e-3)
+            + _records("8x4", "pdhg", 4, iters=50, solve_s=1e-3))
+    router.seed_from_aggregate(aggregate(recs))
+    assert router.table_version == 4
+
+
+# ---------------------------------------------------------------------------
+# shadow budget
+# ---------------------------------------------------------------------------
+
+class _FakeShadowCache:
+    """Stands in for the alternate backend's ExecutableCache so the
+    budget accounting is pinned without a compile."""
+
+    def __init__(self, params):
+        self.params = params
+        self.calls = 0
+
+    def get(self, bucket, slots, dtype, device):
+        self.calls += 1
+
+        def exe(qp, x0, y0):
+            import types
+            return types.SimpleNamespace(
+                status=np.array([1]), iters=np.array([10]),
+                prim_res=np.array([1e-7]), dual_res=np.array([1e-7]),
+                obj_val=np.array([0.5]))
+        return exe
+
+
+def test_shadow_budget_caps_and_defers():
+    """shadow_budget_per_tick bounds evidence-gathering cost: sampled
+    dispatches over budget are deferred (counted, no solve), and the
+    calibration tick's reset_shadow_budget opens the next window."""
+    with pytest.raises(ValueError, match="shadow_budget_per_tick"):
+        SolverRouter(PARAMS, shadow_budget_per_tick=-1)
+
+    import types
+    from porqua_tpu.obs.calibrate import Calibrator
+    router = SolverRouter(PARAMS, shadow_rate=1.0, shadow_seed=0,
+                          shadow_budget_per_tick=2)
+    fake = _FakeShadowCache(PDHG)
+    router.caches["pdhg"] = fake
+    harvest = HarvestSink()
+    cal = Calibrator()
+    lane = types.SimpleNamespace(n_orig=6, m_orig=2, tenant=None)
+    primary = {"status": np.array([1]), "iters": np.array([40]),
+               "obj": np.array([0.4]), "solve_s": 4e-3}
+
+    def shadow():
+        return router.maybe_shadow(Bucket(8, 4, None), 1, None, None,
+                                   None, None, None, "admm", primary,
+                                   [lane], harvest, calibrator=cal)
+
+    ran = [shadow() for _ in range(5)]
+    assert ran == [True, True, False, False, False]
+    snap = router.snapshot()
+    assert snap["shadow_solves"] == 2 and snap["shadow_deferred"] == 3
+    assert fake.calls == 2                     # deferred lanes never solve
+
+    router.reset_shadow_budget()               # the calibration tick
+    assert shadow() is True
+    snap = router.snapshot()
+    assert snap["shadow_solves"] == 3 and snap["shadow_deferred"] == 3
+    assert snap["shadow_budget_per_tick"] == 2
+
+    # Every shadow that RAN produced a serve.shadow record (with the
+    # delta-vs-served fields) and fed the live calibrator.
+    shadows = [r for r in harvest.buffered()
+               if r["source"] == "serve.shadow"]
+    assert len(shadows) == 3
+    assert all(r["shadow_of"] == "admm" and r["delta_iters"] == -30
+               and "delta_solve_s" in r for r in shadows)
+    assert cal.counters()["calibration_observed"] == 3
+
+
+# ---------------------------------------------------------------------------
 # routed serving end to end
 # ---------------------------------------------------------------------------
 

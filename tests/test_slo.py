@@ -345,7 +345,10 @@ class TestFlightRecorder:
             "convergence_anomaly",
             # The fleet plane (obs/federation.py, obs/vitals.py): a
             # crashed loadgen shard or a leaking worker is an incident.
-            "worker_lost", "vitals_anomaly"}
+            "worker_lost", "vitals_anomaly",
+            # The calibration plane (obs/calibrate.py): a promoted
+            # route table the guard window shot down is an incident.
+            "route_rollback"}
 
     def test_failed_dump_does_not_consume_debounce(self, tmp_path):
         # Review fix: a dump that fails to write must not spend the
