@@ -629,6 +629,13 @@ def device_child(platform: str, n_dates: int) -> None:
                 _secondary_config_sketch(child_left)
             else:
                 log(f"skipping cpu sketch A/B ({child_left():.0f}s left)")
+            # The 5,000-asset north-star run: the sketch-fed tracking
+            # path at full paper scale on all three backends.
+            if child_left() > 90:
+                _secondary_config_northstar_5k(child_left)
+            else:
+                log(f"skipping cpu northstar 5k "
+                    f"({child_left():.0f}s left)")
             if child_left() > 120:
                 _secondary_config_routing(child_left)
             else:
@@ -693,6 +700,12 @@ def device_child(platform: str, n_dates: int) -> None:
             _secondary_config_sketch(child_left)
         else:
             log(f"skipping sketch A/B ({child_left():.0f}s left)")
+        # The 5,000-asset north-star run: the sketch-fed tracking path
+        # at full paper scale on all three backends.
+        if child_left() > 120:
+            _secondary_config_northstar_5k(child_left)
+        else:
+            log(f"skipping northstar 5k ({child_left():.0f}s left)")
         if child_left() > 120:
             _secondary_config_routing(child_left)
         else:
@@ -1059,14 +1072,20 @@ def _secondary_config_serving(child_left, n_requests=1024, n_assets=24,
 
 
 def _secondary_config_pdhg(params, child_left, Xs, ys, n_dates,
-                           eps_ab=1e-5, pdhg_max_iter=8000):
-    """PDHG backend A/B on the north-star tracking batch: the same
-    problems solved by ``method="admm"`` and ``method="pdhg"`` (the
-    restarted primal-dual backend behind the identical segment-stepper
+                           eps_ab=1e-5, pdhg_max_iter=8000,
+                           napg_max_iter=4000):
+    """Backend A/B on the north-star tracking batch: the same problems
+    solved by every ``SolverParams.method`` backend (ADMM, the
+    restarted primal-dual PDHG, the Nesterov-accelerated
+    projected-gradient NAPG — all behind the identical segment-stepper
     contract). Per-backend iteration distribution + status counts +
-    wall seconds; the quality bar is the TE band — the PDHG iterate's
-    median tracking error must sit within the existing 2% band of the
-    ADMM one (bench_gate ``config_pdhg.pdhg_te_rel_drift <= 0.02``).
+    wall seconds, emitted as TWO parts: ``config_pdhg`` (the original
+    two-backend payload, schema unchanged so older baselines still
+    diff) and ``config_napg`` (the three-way summary). The quality bar
+    is the TE band — each alternate backend's median tracking error
+    must sit within the existing 2% band of the ADMM one (bench_gate
+    ``config_pdhg.pdhg_te_rel_drift <= 0.02`` and
+    ``config_napg.napg_te_rel_drift <= 0.02``).
 
     Like the compaction A/B this runs at ``eps_ab`` (1e-5), not the
     headline's loose 1e-3: the backends' stopping criteria are shared
@@ -1074,15 +1093,18 @@ def _secondary_config_pdhg(params, child_left, Xs, ys, n_dates,
     their iteration counts actually differentiate — which is the
     evidence the per-(bucket, eps) solver router trains on.
 
-    ``pdhg_max_iter`` gives the PDHG lane its own iteration budget:
-    factorization-free iterations are the backend's entire trade
-    (each costs two C-matvecs + one P-apply, no n^3/3 segment
-    factorization), so holding it to ADMM's 2000-iteration cap on a
-    family where ADMM's factorization shines would measure the cap,
-    not the method. Measured on this host: the TE band needs ~8000
-    PDHG iterations on the tracking batch (drift 0.010 at 8000 vs
-    0.035 at 4000 vs 0.082 at 2000); the tracking cell still routes
-    to ADMM — the wall-clock loss is reported as-is."""
+    ``pdhg_max_iter`` / ``napg_max_iter`` give the alternate lanes
+    their own iteration budgets: factorization-free iterations are
+    those backends' entire trade (no n^3/3 segment factorization), so
+    holding them to ADMM's 2000-iteration cap on a family where
+    ADMM's factorization shines would measure the cap, not the
+    method. Measured on this host: the PDHG TE band needs ~8000
+    iterations on the tracking batch (drift 0.010 at 8000 vs 0.035 at
+    4000 vs 0.082 at 2000); NAPG's exact box+budget prox retires the
+    batch in hundreds of iterations, so 4000 is headroom, not a bar.
+    The tracking cell still routes to ADMM at this size — the
+    wall-clock loss is reported as-is; NAPG's crossover (large
+    box-only buckets) is config_routing's evidence."""
     import jax
 
     from porqua_tpu.qp.solve import solve_qp_batch
@@ -1090,7 +1112,7 @@ def _secondary_config_pdhg(params, child_left, Xs, ys, n_dates,
 
     params = dataclasses.replace(params, eps_abs=eps_ab, eps_rel=eps_ab)
     B = int(Xs.shape[0])
-    log(f"config pdhg (A/B, {B} dates, eps {eps_ab:g})...")
+    log(f"config pdhg/napg (A/B, {B} dates, eps {eps_ab:g})...")
     qps = jax.jit(jax.vmap(build_tracking_qp))(Xs, ys)
     jax.block_until_ready(qps.q)
 
@@ -1099,11 +1121,12 @@ def _secondary_config_pdhg(params, child_left, Xs, ys, n_dates,
         resid = np.einsum("btn,bn->bt", np.asarray(Xs), w) - np.asarray(ys)
         return float(np.median(np.sqrt(np.mean(resid ** 2, axis=1))))
 
+    budgets = {"admm": params.max_iter, "pdhg": pdhg_max_iter,
+               "napg": napg_max_iter}
     per = {}
-    for method in ("admm", "pdhg"):
-        p = dataclasses.replace(
-            params, method=method,
-            max_iter=pdhg_max_iter if method == "pdhg" else params.max_iter)
+    for method in ("admm", "pdhg", "napg"):
+        p = dataclasses.replace(params, method=method,
+                                max_iter=budgets[method])
         t0 = time.perf_counter()
         sol = solve_qp_batch(qps, p)
         np.asarray(sol.status)
@@ -1120,13 +1143,14 @@ def _secondary_config_pdhg(params, child_left, Xs, ys, n_dates,
             **_iteration_distribution(sol.iters, sol.status,
                                       p.check_interval),
         }
-        log(f"config pdhg [{method}]: {solve_s:.3f}s, "
+        log(f"config pdhg/napg [{method}]: {solve_s:.3f}s, "
             f"{per[method]['solved']}/{B} solved, "
             f"iters p50/p95 {per[method]['iters_p50']:.0f}/"
             f"{per[method]['iters_p95']:.0f}, "
             f"TE {per[method]['median_te']:.4e}")
     te_a = per["admm"]["median_te"]
     te_p = per["pdhg"]["median_te"]
+    te_n = per["napg"]["median_te"]
     _emit({
         "part": "config_pdhg",
         "n_dates": B,
@@ -1145,6 +1169,28 @@ def _secondary_config_pdhg(params, child_left, Xs, ys, n_dates,
                 "ADMM one (pdhg_te_rel_drift <= 0.02); which backend "
                 "wins a (bucket, eps) cell is the solver router's call, "
                 "not a global verdict",
+    })
+    _emit({
+        "part": "config_napg",
+        "n_dates": B,
+        "eps_ab": eps_ab,
+        "napg_max_iter": napg_max_iter,
+        "admm": per["admm"],
+        "pdhg": per["pdhg"],
+        "napg": per["napg"],
+        "napg_te_rel_drift": abs(te_n - te_a) / max(abs(te_a), 1e-12),
+        # Speedup of the NAPG backend over the ADMM baseline on this
+        # batch (>1 = NAPG faster) — per-cell, the router decides.
+        "vs_baseline": (per["admm"]["seconds"] / per["napg"]["seconds"]
+                        if per["napg"]["seconds"] > 0 else 0.0),
+        "note": "the three-way A/B: same problems, same stopping "
+                "criteria, three first-order backends "
+                "(SolverParams.method in admm/pdhg/napg) each on its "
+                "own documented iteration budget; acceptance is the "
+                "NAPG iterate's TE within the existing 2% quality band "
+                "of the ADMM one (napg_te_rel_drift <= 0.02); which "
+                "backend wins a (bucket, eps) cell is the solver "
+                "router's call, not a global verdict",
     })
 
 
@@ -1234,6 +1280,144 @@ def _secondary_config_sketch(child_left, n_assets=2048, window=504,
         f"{payload['sketch_off_te_drift']:.2e}")
 
 
+def _secondary_config_northstar_5k(child_left, n_assets=5000, window=504,
+                                   sketch_dim=256, eps=1e-3):
+    """The 5,000-asset north-star: one tracking window an order of
+    magnitude past the 252x500 headline, solved end to end through the
+    sketch-fed path (``SolverParams.sketch_dim`` — the in-program
+    count-sketch ahead of the Gram build) on ALL THREE backends, next
+    to one dense reference solve of the same window.
+
+    What the part certifies:
+
+    * ``gram_rel_err`` — the measured probe bound of the embedding the
+      solve actually ran through (``_sketch_window`` is shared by the
+      jitted path and the certificate path, bit-identical by
+      construction — pinned by tests/test_sketch.py), not an assumed
+      (1 +- eps) guarantee;
+    * per-backend TE drift vs the dense reference, with TE always
+      evaluated on the TRUE window (the sketch may approximate the
+      problem, never the evaluation);
+    * ``recompiles_after_warmup == 0`` — each (backend, sketch_dim)
+      pair is one static executable; the measured dispatches re-enter
+      the warmed jit cache (``_cache_size`` delta), same bar as the
+      serving plane's recompile contract.
+
+    The solve itself stays factorization-free in N: the sketch feeds
+    ``Pf`` (sketch_dim factor rows), so the Woodbury dual-space
+    linsolve factors chol(sketch_dim + m), never chol(N), and the
+    factored scaling mode never touches the dense P. At this size the
+    window compression is the whole Gram-build + factor economy:
+    measured on this host the sketch-fed ADMM solve is ~5x the dense
+    reference's wall."""
+    import jax
+    import jax.numpy as jnp
+
+    from porqua_tpu.qp.sketch import gram_rel_err
+    from porqua_tpu.qp.solve import SolverParams
+    from porqua_tpu.tracking import _sketch_window, tracking_step
+
+    log(f"config northstar_5k (n={n_assets}, window={window}, "
+        f"dim={sketch_dim}, eps {eps:g})...")
+    # Same synthetic-universe recipe as config_sketch (factor returns +
+    # idiosyncratic noise; index = equal-weight slice + irreducible
+    # floor so TE_dense is a real number), at the north-star size.
+    rng = np.random.default_rng(7)
+    F = rng.standard_normal((window, 8))
+    L = rng.standard_normal((8, n_assets))
+    X = ((F @ L + 0.5 * rng.standard_normal((window, n_assets)))
+         * 0.01).astype(np.float32)
+    y = (X[:, : max(n_assets // 40, 8)].mean(axis=1)
+         + 0.001 * rng.standard_normal(window)).astype(np.float32)
+    Xb, yb = jnp.asarray(X[None]), jnp.asarray(y[None])
+
+    base = SolverParams(max_iter=2000, eps_abs=eps, eps_rel=eps,
+                        polish=False, linsolve="woodbury",
+                        woodbury_refine=0, check_interval=35,
+                        scaling_mode="factored")
+    budgets = {"admm": 2000, "pdhg": 8000, "napg": 4000}
+
+    recompiles = 0
+
+    def run(p):
+        nonlocal recompiles
+        fn = jax.jit(lambda A, b: tracking_step(A, b, p))
+        t0 = time.perf_counter()
+        res = fn(Xb, yb)
+        jax.block_until_ready(res.tracking_error)
+        compile_s = time.perf_counter() - t0
+        warm = fn._cache_size()
+        t0 = time.perf_counter()
+        res = fn(Xb, yb)
+        jax.block_until_ready(res.tracking_error)
+        solve_s = time.perf_counter() - t0
+        recompiles += fn._cache_size() - warm
+        return {
+            "seconds": solve_s,
+            "compile_s": round(compile_s, 2),
+            "solved": int(np.asarray(res.status)[0] == 1),
+            "iters": int(np.asarray(res.iters)[0]),
+            "te": float(np.asarray(res.tracking_error)[0]),
+        }
+
+    dense = run(base)
+    te_dense = dense["te"]
+    per = {}
+    for method in ("admm", "pdhg", "napg"):
+        per[method] = run(dataclasses.replace(
+            base, method=method, max_iter=budgets[method],
+            sketch_dim=sketch_dim, sketch_seed=3))
+        per[method]["te_rel_drift"] = (abs(per[method]["te"] - te_dense)
+                                       / max(abs(te_dense), 1e-12))
+        log(f"config northstar_5k [{method}]: "
+            f"{per[method]['seconds']:.3f}s, "
+            f"solved {per[method]['solved']}, "
+            f"iters {per[method]['iters']}, "
+            f"TE {per[method]['te']:.4e} "
+            f"(drift {per[method]['te_rel_drift']:.3f})")
+    # The certificate: the same seeded embedding the jitted path used
+    # (one _sketch_window helper, two callers — bit-identical), its
+    # Gram error measured with the probe bound.
+    Xs_, _ys_, k_probe = _sketch_window(jnp.asarray(X), jnp.asarray(y),
+                                        sketch_dim, 3)
+    cert = float(gram_rel_err(jnp.asarray(X), Xs_, k_probe, probes=8))
+    payload = {
+        "part": "config_northstar_5k",
+        "n_assets": n_assets,
+        "window": window,
+        "sketch_dim": sketch_dim,
+        "eps": eps,
+        "iteration_budgets": budgets,
+        "dense": dense,
+        "admm": per["admm"],
+        "pdhg": per["pdhg"],
+        "napg": per["napg"],
+        "gram_rel_err": cert,
+        "te_dense": te_dense,
+        "te_rel_drift_max": max(e["te_rel_drift"] for e in per.values()),
+        "solved_all": int(dense["solved"]
+                          and all(e["solved"] for e in per.values())),
+        "recompiles_after_warmup": recompiles,
+        # Sketch-fed speedup over the dense reference on the primary
+        # backend (>1 = the embedding pays for itself at this size).
+        "vs_dense": (dense["seconds"] / per["admm"]["seconds"]
+                     if per["admm"]["seconds"] > 0 else 0.0),
+        "note": "5,000-asset tracking window through the sketch-fed "
+                "jitted path (SolverParams.sketch_dim) on all three "
+                "backends vs one dense reference; TE always evaluated "
+                "on the TRUE window; gram_rel_err is the measured probe "
+                "bound of the exact embedding the solve ran through; "
+                "acceptance is gram_rel_err under its measured ceiling, "
+                "every arm solved, TE drift within the measured band, "
+                "and recompiles_after_warmup == 0",
+    }
+    _emit(payload)
+    log(f"config northstar_5k: dense {dense['seconds']:.3f}s / sketch "
+        f"admm {per['admm']['seconds']:.3f}s (x{payload['vs_dense']:.1f}); "
+        f"gram_rel_err {cert:.3f}; drift max "
+        f"{payload['te_rel_drift_max']:.3f}; recompiles {recompiles}")
+
+
 def _secondary_config_hlo(child_left):
     """Post-lowering HLO lint part: harvest every entry-point program
     through ``jit(...).lower(...).compile()``
@@ -1271,44 +1455,66 @@ def _secondary_config_hlo(child_left):
 
 
 def _secondary_config_routing(child_left, n_small=24, n_large=96,
-                              per_bucket=24, max_batch=8):
-    """Per-(bucket, eps) solver routing, end to end: phase A serves two
-    bucket populations through a shadow-comparing
-    :class:`porqua_tpu.serve.routing.SolverRouter` (every dispatch
-    re-solved on the alternate backend into the harvest warehouse),
-    the route table is seeded from that evidence, and phase B serves
-    the same traffic routed — measuring steady-state recompiles
-    (contract: 0, both backends prewarmed), per-backend routing
-    counts, and exact harvest reconciliation (one serve record per
-    completed request). The artifact's acceptance evidence is the
-    seeded table itself: the cells where PDHG won its bucket on
-    iteration p95 / latency, next to the per-cell numbers."""
+                              n_big=384, per_bucket=24, per_big=16,
+                              max_batch=8):
+    """Per-(bucket, eps) solver routing, end to end, THREE WAYS: phase
+    A serves three bucket populations through a shadow-comparing
+    :class:`porqua_tpu.serve.routing.SolverRouter` (each dispatch
+    re-solved on one sampled losing backend into the harvest
+    warehouse), the route table is seeded from that evidence, and
+    phase B serves the same traffic routed — measuring steady-state
+    recompiles (contract: 0, every backend's ladder prewarmed),
+    per-backend routing counts, and exact harvest reconciliation (one
+    serve record per completed request). The artifact's acceptance
+    evidence is the seeded table itself: a three-way table where each
+    backend won the (bucket, eps) cell its algorithm is actually best
+    at, next to the per-cell numbers.
+
+    The three populations are three solver regimes on purpose:
+
+    * small tracking (budget row + box, n=24 -> 32x1): ADMM's factored
+      iteration clears it in tens of iterations — ADMM's cell;
+    * exposure-banded mean-variance (15 general rows, n=96 -> 128x32):
+      the general rows put the work in the dual — the restarted PDHG
+      backend's cell;
+    * LARGE tracking (budget row + box, n=384 -> 512x1): past the
+      measured crossover where ADMM's per-segment n^3/3 factorization
+      costs more than NAPG's factorization-free accelerated sweeps
+      (and PDHG honestly fails the family at this eps) — the NAPG
+      backend's cell.
+
+    The ladder carries an m=1 rung so the box+budget populations keep
+    their one-row shape: padding tracking QPs into an m=8 bucket makes
+    every backend pay 8 dual rows for 1 real one — and NAPG's
+    per-row exact prox pays it 8 times per iteration, which would
+    erase exactly the crossover this config exists to measure."""
     from porqua_tpu.obs.harvest import HarvestSink, aggregate
     from porqua_tpu.qp.solve import SolverParams
     from porqua_tpu.serve import SolveService, SolverRouter
+    from porqua_tpu.serve.bucketing import BucketLadder
     from porqua_tpu.serve.loadgen import (build_exposure_requests,
                                           build_tracking_requests)
 
     params = SolverParams(max_iter=4000, eps_abs=1e-5, eps_rel=1e-5,
                           polish=False, check_interval=25)
-    log(f"config routing (buckets n={n_small}/{n_large}, "
-        f"{per_bucket}/bucket)...")
-    # Two production populations in two regimes: per-date tracking QPs
-    # (one budget row — ADMM's factored iteration converges in tens of
-    # iterations) and exposure-banded mean-variance QPs (general
-    # inequality rows — the restarted PDHG backend's regime). The
-    # router has to learn BOTH cells right.
-    reqs = (build_tracking_requests(per_bucket, n_assets=n_small,
+    log(f"config routing (buckets n={n_small}/{n_large}/{n_big}, "
+        f"{per_bucket}/{per_bucket}/{per_big} per bucket)...")
+    small = build_tracking_requests(per_bucket, n_assets=n_small,
                                     window=64, seed=11)
-            + build_exposure_requests(per_bucket, n_assets=n_large,
-                                      n_rows=16, seed=12))
+    large = build_exposure_requests(per_bucket, n_assets=n_large,
+                                    n_rows=16, seed=12)
+    big = build_tracking_requests(per_big, n_assets=n_big,
+                                  window=64, seed=13)
+    reqs = small + large + big
+    ladder = BucketLadder(n_rungs=(32, 128, 512), m_rungs=(1, 32))
 
-    def serve(router, sink):
-        svc = SolveService(params=params, max_batch=max_batch,
-                           max_wait_ms=1.0, router=router, harvest=sink)
+    def serve(router, sink, rounds=1):
+        svc = SolveService(params=params, ladder=ladder,
+                           max_batch=max_batch, max_wait_ms=1.0,
+                           router=router, harvest=sink)
         svc.start()
-        svc.prewarm(reqs[0])
-        svc.prewarm(reqs[-1])
+        for example in (small[0], large[0], big[0]):
+            svc.prewarm(example)
         # Warmup round (loadgen protocol): the first call of a fresh
         # executable pays one-time dispatch setup, and the shadow
         # re-solve always runs SECOND on the same batch — without this
@@ -1323,17 +1529,22 @@ def _secondary_config_routing(child_left, n_small=24, n_large=96,
         skip = len(sink.buffered())
         svc.metrics.reset_window()
         t0 = time.perf_counter()
-        tickets = [svc.submit(q) for q in reqs]
-        results = [svc.result(t, timeout=300) for t in tickets]
+        results = []
+        for _ in range(rounds):
+            tickets = [svc.submit(q) for q in reqs]
+            results += [svc.result(t, timeout=300) for t in tickets]
         wall = time.perf_counter() - t0
         svc.stop()
         return results, svc.metrics.snapshot(), wall, sink.buffered()[skip:]
 
-    # Phase A: evidence. Default routes (ADMM) serve; every dispatch
-    # shadow-solves on PDHG into the warehouse.
+    # Phase A: evidence. Default routes (ADMM) serve; each dispatch
+    # shadow-solves on ONE sampled loser into the warehouse — two
+    # evidence rounds so both losers accumulate samples in every cell
+    # (the sampled-alternate stream halves per-loser evidence density
+    # vs the old two-backend always-the-other scheme).
     sink_a = HarvestSink()
     router = SolverRouter(params, shadow_rate=1.0, shadow_seed=0)
-    _, snap_a, _, recs_a = serve(router, sink_a)
+    _, snap_a, _, recs_a = serve(router, sink_a, rounds=2)
     agg = aggregate(recs_a)
     routes = router.seed_from_aggregate(agg)
     evidence = {}
@@ -1360,6 +1571,7 @@ def _secondary_config_routing(child_left, n_small=24, n_large=96,
                                                        "admm"), 0) + 1
     unsolved = sum(r.status != 1 for r in results)
     pdhg_cells = sorted(c for c, m in routes.items() if m == "pdhg")
+    napg_cells = sorted(c for c, m in routes.items() if m == "napg")
     payload = {
         "part": "config_routing",
         "n_requests": len(reqs),
@@ -1369,9 +1581,14 @@ def _secondary_config_routing(child_left, n_small=24, n_large=96,
         "evidence": evidence,
         "routes": routes,
         "pdhg_routed_cells": pdhg_cells,
+        "napg_routed_cells": napg_cells,
+        # The three-way acceptance bit bench_gate pins: the seeded
+        # table routes NAPG on at least one (bucket, eps) cell.
+        "napg_routed_any": int(bool(napg_cells)),
         "routed_by_bucket": routed_by_bucket,
         "routed_admm": snap_b["routed_admm"],
         "routed_pdhg": snap_b["routed_pdhg"],
+        "routed_napg": snap_b["routed_napg"],
         "shadow_solves_phase_a": snap_a["shadow_solves"],
         "recompiles_after_warmup": snap_b["compiles"],
         "unsolved": int(unsolved),
@@ -1382,16 +1599,19 @@ def _secondary_config_routing(child_left, n_small=24, n_large=96,
             len(serve_recs) == len(results) == snap_b["completed"]
             and all("solver" in r for r in serve_recs)),
         "router": router.snapshot(),
-        "note": "phase A serves with shadow-compare (alternate-backend "
-                "re-solves harvested), the route table seeds from that "
-                "aggregate, phase B serves routed; acceptance is "
-                "recompiles_after_warmup == 0 (both backends "
-                "prewarmed), harvest_reconciled == 1, and the table "
-                "itself showing where PDHG won its (bucket, eps) cell",
+        "note": "phase A serves with shadow-compare (sampled "
+                "losing-backend re-solves harvested), the route table "
+                "seeds from that aggregate, phase B serves routed; "
+                "acceptance is recompiles_after_warmup == 0 (every "
+                "backend's ladder prewarmed), harvest_reconciled == 1, "
+                "and the three-way table itself: ADMM keeps the small "
+                "tracking cell, PDHG wins the exposure cell, NAPG wins "
+                "the large box-only cell (napg_routed_any == 1)",
     }
     _emit(payload)
-    log(f"config routing: routes {routes}; routed admm/pdhg "
-        f"{snap_b['routed_admm']}/{snap_b['routed_pdhg']}; recompiles "
+    log(f"config routing: routes {routes}; routed admm/pdhg/napg "
+        f"{snap_b['routed_admm']}/{snap_b['routed_pdhg']}/"
+        f"{snap_b['routed_napg']}; recompiles "
         f"{snap_b['compiles']}; reconciled "
         f"{payload['harvest_reconciled']}; unsolved {unsolved}")
 

@@ -839,9 +839,9 @@ def check_routing_identity(dtype=np.float32) -> List[Finding]:
     force-pinnable), it never changes what any executable computes.
     This check machine-verifies the enabled half of "routing disabled
     == bit-identical": the solve/serve entry points are traced bare
-    (for BOTH backends — the routed programs), then a live router is
+    (for EVERY backend — the routed programs), then a live router is
     exercised for real — per-bucket decisions taken against a seeded
-    table, a winner seeded from a two-backend harvest aggregate, a
+    table, a winner seeded from a harvest aggregate, a
     force() flip, a snapshot — and the entry points are re-traced.
     The jaxprs must be string-identical, and the probe self-verifies
     it actually routed (a table that seeded nothing, or decisions
@@ -857,7 +857,7 @@ def check_routing_identity(dtype=np.float32) -> List[Finding]:
 
     def trace_all():
         out = []
-        for method in ("admm", "pdhg"):
+        for method in ("admm", "pdhg", "napg"):
             p = dataclasses.replace(params, method=method)
             out.append((f"solve_batch[{method}]",
                         str(solve_batch_jaxpr(params=p, dtype=dtype))))
@@ -921,8 +921,8 @@ def check_calibration_identity(dtype=np.float32) -> List[Finding]:
     per-cell statistics, a staged promotion swapping the router's
     versioned route table, a guard window auto-reverting on drift.
     All of it is host-side dispatch SELECTION: it may only ever change
-    which prewarmed executable a batch runs on. This check traces both
-    backends' solve/serve entry points bare, then drives a live
+    which prewarmed executable a batch runs on. This check traces
+    every backend's solve/serve entry points bare, then drives a live
     calibrator through the ENTIRE lifecycle on a stepped clock —
     evidence ingested (valid + rejected records), a candidate gated
     into canary, a promotion (version bump), a guard breach, the
@@ -945,7 +945,7 @@ def check_calibration_identity(dtype=np.float32) -> List[Finding]:
 
     def trace_all():
         out = []
-        for method in ("admm", "pdhg"):
+        for method in ("admm", "pdhg", "napg"):
             p = dataclasses.replace(params, method=method)
             out.append((f"solve_batch[{method}]",
                         str(solve_batch_jaxpr(params=p, dtype=dtype))))
@@ -1169,15 +1169,38 @@ def check_entry_points(dtype=np.float32,
     for label, jaxpr in continuous_jaxprs(params=pdhg, dtype=dtype):
         findings += check_closed_jaxpr(
             jaxpr, f"{label}[pdhg]", expect_float=dtype)
+    # And the NAPG backend's — the third routed peer: the accelerated
+    # projected-gradient stepper (one P-apply + the row-prox bisection
+    # per iteration) must clear the same sync-free/f64-free/dtype bars
+    # through the same shared plumbing.
+    napg = SolverParams(method="napg")
+    findings += check_closed_jaxpr(
+        solve_batch_jaxpr(params=napg, dtype=dtype),
+        "solve_batch[napg]", expect_float=dtype)
+    findings += check_closed_jaxpr(
+        serve_entry_jaxpr(params=napg, dtype=dtype),
+        "serve_entry[napg]", expect_float=dtype)
+    if ring_size:
+        findings += check_closed_jaxpr(
+            solve_batch_jaxpr(
+                params=SolverParams(method="napg", ring_size=ring_size),
+                dtype=dtype),
+            "solve_batch[napg,rings]", expect_float=dtype)
+    findings += check_closed_jaxpr(
+        compaction_step_jaxpr(params=napg, dtype=dtype),
+        "compaction_step[napg]", expect_float=dtype)
+    for label, jaxpr in continuous_jaxprs(params=napg, dtype=dtype):
+        findings += check_closed_jaxpr(
+            jaxpr, f"{label}[napg]", expect_float=dtype)
     # GC110: and for solver routing — a harvest-seeded route table
     # consulted per bucket, a force() flip, a snapshot — all of it
-    # must leave both backends' traced solve/serve programs string-
+    # must leave every backend's traced solve/serve programs string-
     # identical (routing picks which compiled program runs, it never
     # touches a traced one).
     findings += check_routing_identity(dtype=dtype)
     # GC111: and for the closed calibration loop — evidence folded,
     # a candidate promoted through canary, a guard breach rolled back,
-    # the audit chain replayed — all of it must leave both backends'
+    # the audit chain replayed — all of it must leave every backend's
     # traced solve/serve programs string-identical (calibration only
     # ever picks which prewarmed executable runs).
     findings += check_calibration_identity(dtype=dtype)

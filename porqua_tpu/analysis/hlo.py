@@ -82,9 +82,9 @@ def entry_point_programs(dtype=np.float32,
     (GC104-GC110) are properties of *these* programs, not extra ones.
     The labels match the contract sweep's so a finding, a CostRecord,
     and a jaxpr contract all name the same program. ``serve_entry`` /
-    ``serve_entry[pdhg]`` are the routed dispatch pair — the two
-    executables :class:`porqua_tpu.serve.routing.SolverRouter` picks
-    between."""
+    ``serve_entry[pdhg]`` / ``serve_entry[napg]`` are the routed
+    dispatch set — the executables
+    :class:`porqua_tpu.serve.routing.SolverRouter` picks between."""
     from porqua_tpu.analysis import contracts
     from porqua_tpu.qp.solve import SolverParams
 
@@ -126,6 +126,26 @@ def entry_point_programs(dtype=np.float32,
     for label, fn, args in contracts.continuous_programs(
             params=pdhg, dtype=dtype):
         progs.append((f"{label}[pdhg]", fn, args))
+    napg = SolverParams(method="napg")
+    add("solve_batch[napg]", contracts.solve_batch_program(
+        params=napg, dtype=dtype))
+    add("serve_entry[napg]", contracts.serve_entry_program(
+        params=napg, dtype=dtype))
+    if ring_size:
+        add("solve_batch[napg,rings]", contracts.solve_batch_program(
+            params=SolverParams(method="napg", ring_size=ring_size),
+            dtype=dtype))
+    add("compaction_step[napg]", contracts.compaction_step_program(
+        params=napg, dtype=dtype))
+    for label, fn, args in contracts.continuous_programs(
+            params=napg, dtype=dtype):
+        progs.append((f"{label}[napg]", fn, args))
+    # The sketch-fed tracking path is its own executable (sketch_dim is
+    # a static jit key): the count-sketch Gram embedding must lint and
+    # fingerprint like any other routed program. window=8 -> dim 4
+    # compresses, exercising the enabled branch.
+    add("tracking_step[sketch]", contracts.tracking_program(
+        params=SolverParams(sketch_dim=4), dtype=dtype))
     return progs
 
 

@@ -16,7 +16,7 @@ table:
 evidence must *hold* ``min_samples`` per changed cell and a
 ``win_rate`` threshold on the shadow comparisons) → **promoted**
 (:meth:`SolverRouter.set_table` — a version bump, 0 recompiles thanks
-to the prewarmed-both-ladders invariant) → ``guard`` (a window
+to the prewarmed-every-ladder invariant) → ``guard`` (a window
 watching the EXISTING :class:`~porqua_tpu.obs.anomaly.AnomalyDetector`
 fired count and :class:`~porqua_tpu.obs.slo.SLOEngine` firing alerts
 for policy-induced drift) → ``idle``; a guard breach auto-reverts to
@@ -63,7 +63,7 @@ AUDIT_SCHEMA_VERSION = 1
 #: Mirrors ``porqua_tpu.serve.routing.METHODS`` — restated host-side
 #: so importing this module initializes no JAX backend (the obs
 #: package promise; the router re-validates methods on every swap).
-_METHODS = ("admm", "pdhg")
+_METHODS = ("admm", "pdhg", "napg")
 
 #: ``int(porqua_tpu.qp.admm.Status.SOLVED)`` restated for the same
 #: reason; harvest records carry the status as this integer.
@@ -100,9 +100,11 @@ class Calibrator:
         clock gate between ticks (evidence folds continuously; the
         state machine advances at most this often).
     ``min_samples``
-        per changed cell, BOTH backends must have at least this many
-        valid evidence records AND the incoming winner at least this
-        many shadow comparisons before a candidate may enter canary.
+        evidence-maturity bar: per cell, only backends with at least
+        this many valid evidence records are scored as contenders (at
+        least two must mature for any comparison), AND the incoming
+        winner needs this many shadow comparisons before a candidate
+        may enter canary.
     ``win_rate``
         fraction of the winner's shadow comparisons that must be wins
         (served answer agreed AND the shadow was strictly faster —
@@ -317,17 +319,23 @@ class Calibrator:
         """The would-be next table plus the gated evidence diff.
         Scoring per cell matches ``seed_from_aggregate`` (solved share
         first, then mean dispatch latency when every contender has
-        one, then mean iterations, then name); a changed cell enters
-        the diff only when BOTH backends carry ``min_samples`` records
-        and the incoming winner's shadow comparisons clear the
-        ``win_rate`` bar on at least ``min_samples`` samples — the
-        staged-promotion gate."""
+        one, then mean iterations, then name) over every backend with
+        ``min_samples`` evidence records — with three backends a cell
+        is scored across all contenders that have matured, and a
+        still-thin third stream cannot block the two thick ones from
+        comparing (it simply is not a contender yet); a changed cell
+        enters the diff only when the incoming winner's shadow
+        comparisons also clear the ``win_rate`` bar on at least
+        ``min_samples`` samples — the staged-promotion gate."""
         active = (self.router.table() if self.router is not None else {})
         candidate = dict(active)
         diff: Dict[str, Dict[str, Any]] = {}
         with self._lock:
             for cell in sorted(self._evidence):
-                stats = self._cell_stats(cell)
+                # Only matured contenders score: a method below
+                # min_samples has no seat at the table this tick.
+                stats = {m: e for m, e in self._cell_stats(cell).items()
+                         if e["count"] >= self.min_samples}
                 if len(stats) < 2:
                     continue
                 have_lat = all(e["solve_s_mean"] is not None
@@ -343,9 +351,6 @@ class Calibrator:
                 winner = min(stats.items(), key=score)[0]
                 incumbent = self._active_route(active, cell)
                 if winner == incumbent:
-                    continue
-                if any(e["count"] < self.min_samples
-                       for e in stats.values()):
                     continue
                 shadow = self._shadow_stats(cell, winner)
                 if (shadow is None

@@ -88,6 +88,14 @@ BENCH_METRICS = (
     "config_calibration.rollbacks",
     "config_calibration.route_table_version",
     "config_calibration.win_rate",
+    "config_napg.napg_te_rel_drift",
+    "config_napg.vs_baseline",
+    "config_routing.napg_routed_any",
+    "config_routing.recompiles_after_warmup",
+    "config_northstar_5k.gram_rel_err",
+    "config_northstar_5k.te_rel_drift_max",
+    "config_northstar_5k.vs_dense",
+    "config_northstar_5k.recompiles_after_warmup",
 )
 
 #: Loadgen-report metrics lifted into a ledger row. The

@@ -74,13 +74,15 @@ class SolverParams:
 
     max_iter: int = 4000
     check_interval: int = 25
-    # First-order backend: "admm" (this module) or "pdhg" (restarted
-    # primal-dual hybrid gradient, qp/pdhg.py). Both implement the same
-    # segment-stepper contract (init / segment_step / shared finalize),
-    # run on the same Ruiz-equilibrated canonical form, and carry their
-    # state as an ADMMState — so compaction, continuous batching,
-    # serving, harvest, and the ring telemetry work unmodified for
-    # either. Part of the params hash, hence of every executable-cache
+    # First-order backend: "admm" (this module), "pdhg" (restarted
+    # primal-dual hybrid gradient, qp/pdhg.py) or "napg" (Nesterov-
+    # accelerated projected gradient for the box-dominated regime,
+    # qp/napg.py). All implement the same segment-stepper contract
+    # (init / segment_step / shared finalize), run on the same
+    # Ruiz-equilibrated canonical form, and carry their state as an
+    # ADMMState — so compaction, continuous batching, serving,
+    # harvest, and the ring telemetry work unmodified for any of
+    # them. Part of the params hash, hence of every executable-cache
     # identity: per-backend executables come for free.
     method: str = "admm"
     # "auto" == "xla" everywhere: the fused Pallas kernel is opt-in
@@ -208,6 +210,27 @@ class SolverParams:
     # Power-iteration count for the ||P||/||C|| spectral estimates
     # computed once at pdhg_init (they set the step sizes).
     pdhg_power_iters: int = 20
+    # NAPG backend knobs (method="napg" only; inert otherwise so the
+    # other backends' params identity is unchanged by their presence).
+    # napg_power_iters: the one-time ||P|| power iteration at napg_init
+    # (sets tau = 1/L). napg_project_cycles: dual coordinate-ascent
+    # sweeps of the exact box(+L1) ∩ C-rows prox — 1 is exact for the
+    # single-budget-row tracking family this backend exists for.
+    # napg_bisect_iters: bisection steps per row multiplier (each
+    # halves the dual bracket; 32 reaches f32 resolution).
+    napg_power_iters: int = 20
+    napg_project_cycles: int = 1
+    napg_bisect_iters: int = 32
+    # Sketch-fed problem assembly (the tracking path only — inert in
+    # the solver itself, but part of the params hash so sketched and
+    # dense pipelines compile to distinct executables). With
+    # 0 < sketch_dim < window, porqua_tpu.tracking.build_tracking_qp
+    # routes the Gram build through the count-sketch row embedding
+    # (qp/canonical.sketch_rows, seeded by sketch_seed); 0 — the
+    # default — is the bit-exact dense passthrough, pinned by the
+    # bench sketch_off_identity rule.
+    sketch_dim: int = 0
+    sketch_seed: int = 0
     scaling_iters: int = 10
     # "ruiz": modified Ruiz sweeps over the dense P (scaling_iters of
     # them). "factored": Jacobi scaling computed from the objective

@@ -308,3 +308,27 @@ def pad_qp(qp: CanonicalQP, n_max: int, m_max: int) -> CanonicalQP:
         constant=f(qp.constant).astype(dtype),
         Pf=Pf_pad, Pdiag=Pd_pad,
     )
+
+
+def sketch_rows(M: jax.Array, sketch_dim: int, key: jax.Array) -> jax.Array:
+    """Clarkson-Woodruff count-sketch of the leading (row) axis:
+    ``(T, k) -> (sketch_dim, k)``. Each row lands in one signed bucket,
+    so the whole embedding is a single ``segment_sum`` — O(T k), no
+    matmul, trivially fused by XLA into the surrounding assembly.
+
+    This is the Gram-compression primitive the canonical lowering layer
+    owns: applied to a stacked ``[X | y]`` return window before
+    ``build_tracking_qp``, the assembled ``P = 2 Xs'Xs`` is a subspace
+    embedding of the true Gram with the usual (1 ± eps) guarantee, and
+    the ``Pf`` factor the Woodbury/first-order paths carry shrinks from
+    T to ``sketch_dim`` rows. Seeded and deterministic: same
+    ``(key, shapes)`` => same embedding, so reruns and multi-host
+    replays reconcile. ``qp.sketch`` layers the measured
+    ``gram_rel_err`` certificate and passthrough policy on top.
+    """
+    T = M.shape[0]
+    kb, ks = jax.random.split(key)
+    bucket = jax.random.randint(kb, (T,), 0, sketch_dim)
+    sign = jax.random.rademacher(ks, (T,), M.dtype)
+    return jax.ops.segment_sum(sign[:, None] * M, bucket,
+                               num_segments=sketch_dim)

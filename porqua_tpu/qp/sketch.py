@@ -40,8 +40,8 @@ from typing import NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from porqua_tpu.qp.canonical import HP
-from porqua_tpu.tracking import TrackingResult, build_tracking_qp
+from porqua_tpu.qp.canonical import HP, sketch_rows
+from porqua_tpu.tracking import TrackingResult, _sketch_window, build_tracking_qp
 
 __all__ = [
     "SketchParams",
@@ -85,15 +85,12 @@ class SketchInfo(NamedTuple):
 
 def count_sketch(M: jax.Array, sketch_dim: int, key: jax.Array) -> jax.Array:
     """Apply a Clarkson-Woodruff count-sketch to the leading (row) axis:
-    ``(T, k) -> (sketch_dim, k)``. Each row lands in one signed bucket,
-    so the whole embedding is a single ``segment_sum`` — O(T k), no
-    matmul, and trivially fused by XLA into the surrounding assembly."""
-    T = M.shape[0]
-    kb, ks = jax.random.split(key)
-    bucket = jax.random.randint(kb, (T,), 0, sketch_dim)
-    sign = jax.random.rademacher(ks, (T,), M.dtype)
-    return jax.ops.segment_sum(sign[:, None] * M, bucket,
-                               num_segments=sketch_dim)
+    ``(T, k) -> (sketch_dim, k)``. Alias of
+    :func:`porqua_tpu.qp.canonical.sketch_rows` — the primitive moved
+    to the canonical lowering layer when ``build_tracking_qp`` grew the
+    in-program sketch-fed path, so the solve path and this certificate
+    path share one embedding by construction."""
+    return sketch_rows(M, sketch_dim, key)
 
 
 def gram_rel_err(X: jax.Array, Xs: jax.Array, key: jax.Array,
@@ -150,11 +147,7 @@ def sketched_tracking_qp(X: jax.Array,
         )
         return qp, info
 
-    key = jax.random.key(sketch.seed)
-    k_embed, k_probe = jax.random.split(key)
-    stacked = jnp.concatenate([X, y[:, None]], axis=1)
-    sk = count_sketch(stacked, d, k_embed)
-    Xs, ys = sk[:, :-1], sk[:, -1]
+    Xs, ys, k_probe = _sketch_window(X, y, d, sketch.seed)
     qp = build_tracking_qp(Xs, ys, ridge=ridge, lb=lb, ub=ub)
     info = SketchInfo(
         sketch_dim=jnp.asarray(d, jnp.int32),

@@ -30,6 +30,7 @@ from porqua_tpu.qp.admm import (
     _support,
 )
 from porqua_tpu.qp.canonical import CanonicalQP, HP
+from porqua_tpu.qp.napg import napg_init, napg_segment_step, napg_solve
 from porqua_tpu.qp.pdhg import pdhg_init, pdhg_segment_step, pdhg_solve
 from porqua_tpu.qp.polish import polish_iterate as _polish_iterate
 from porqua_tpu.qp.ruiz import Scaling, equilibrate, equilibrate_factored
@@ -48,8 +49,11 @@ def _backend(params: SolverParams):
         return admm_init, admm_segment_step, admm_solve
     if params.method == "pdhg":
         return pdhg_init, pdhg_segment_step, pdhg_solve
+    if params.method == "napg":
+        return napg_init, napg_segment_step, napg_solve
     raise ValueError(
-        f"unknown method {params.method!r}; expected 'admm' or 'pdhg'")
+        f"unknown method {params.method!r}; expected 'admm', 'pdhg' "
+        "or 'napg'")
 
 
 class QPSolution(NamedTuple):

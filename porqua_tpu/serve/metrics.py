@@ -68,6 +68,7 @@ COUNTERS = (
     # Solver routing (porqua_tpu.serve.routing):
     "routed_admm",          # live requests dispatched on the ADMM backend
     "routed_pdhg",          # live requests dispatched on the PDHG backend
+    "routed_napg",          # live requests dispatched on the NAPG backend
     "shadow_solves",        # shadow-compare batches run on the alternate
 )
 
@@ -88,6 +89,7 @@ TENANT_COUNTERS = (
     "warm_hits",          # warm-start cache hits
     "routed_admm",        # this tenant's requests served by ADMM
     "routed_pdhg",        # this tenant's requests served by PDHG
+    "routed_napg",        # this tenant's requests served by NAPG
 )
 
 #: Status code -> counter suffix (mirrors porqua_tpu.qp.admm.Status —

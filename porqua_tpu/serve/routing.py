@@ -1,10 +1,14 @@
 """Per-(bucket, eps) solver routing: pick the winning backend at admission.
 
-With two first-order backends behind one segment-stepper contract
-(``SolverParams(method="admm" | "pdhg")``), which one wins is an
-empirical, per-workload-cell question: ADMM's factorization amortizes
-beautifully at small n and tight eps, PDHG's factorization-free
-segments win where the per-segment n^3/3 factorization dominates. The
+With N first-order backends behind one segment-stepper contract
+(``SolverParams(method="admm" | "pdhg" | "napg")``), which one wins is
+an empirical, per-workload-cell question: ADMM's factorization
+amortizes beautifully at small n and tight eps, PDHG's
+factorization-free segments win where the per-segment n^3/3
+factorization dominates, and NAPG's projection-only iterations own the
+box+budget tracking buckets. Everything below is N-ary over
+``METHODS`` — adding a backend is one tuple entry, not a router
+rewrite. The
 :class:`SolverRouter` makes that choice data-driven and *host-side
 only* (contract GC110: solve jaxprs are string-identical with a live
 router vs bare — routing picks which pre-compiled executable runs,
@@ -13,20 +17,23 @@ it never touches a traced program):
 * one :class:`~porqua_tpu.serve.bucketing.ExecutableCache` per backend
   (identical ``SolverParams`` except ``method``, so the caches' params
   hashes — and hence every executable identity — differ exactly by
-  backend), with :meth:`prewarm` compiling BOTH ladders so a routing
-  flip mid-stream dispatches into an already-compiled executable
-  (0 recompiles, the chaos ``solver_route_flap`` invariant);
+  backend), with :meth:`prewarm` compiling EVERY backend's ladder so a
+  routing flip mid-stream dispatches into an already-compiled
+  executable (0 recompiles, the chaos ``solver_route_flap``
+  invariant);
 * a route table ``(bucket_label, eps_abs) -> method`` seeded from the
   harvest warehouse's per-solver aggregates
   (:func:`porqua_tpu.obs.harvest.aggregate` ``by_solver`` sub-tables,
   the same evidence ``harvest_report`` renders): per cell the backend
   with the lower count-weighted mean dispatch latency wins, iteration
   p95 breaking ties when latency was not recorded;
-* per-tenant routing attribution (``routed_admm`` / ``routed_pdhg``
-  counters in :class:`~porqua_tpu.serve.metrics.ServeMetrics`, bumped
+* per-tenant routing attribution (one ``routed_<method>`` counter per
+  backend in :class:`~porqua_tpu.serve.metrics.ServeMetrics`, bumped
   by the batcher per routed request);
 * a **shadow-compare** mode: a sampled fraction of dispatches re-solve
-  the same padded batch on the *other* backend after the primary
+  the same padded batch on one of the *losing* backends — chosen
+  uniformly from the seeded sampling RNG, so with three backends every
+  loser keeps accumulating evidence — after the primary
   answer has already been returned, and each shadow lane lands in the
   harvest warehouse as a ``source="serve.shadow"`` record carrying the
   loser's outcome plus the per-lane delta vs the served answer
@@ -67,7 +74,7 @@ from porqua_tpu.serve.tenancy import DEFAULT_TENANT
 __all__ = ["SolverRouter", "METHODS"]
 
 #: The routable backends (the ``SolverParams.method`` domain).
-METHODS = ("admm", "pdhg")
+METHODS = ("admm", "pdhg", "napg")
 
 
 class SolverRouter:
@@ -76,7 +83,7 @@ class SolverRouter:
     ``params`` is the service's :class:`~porqua_tpu.qp.solve.
     SolverParams`; its ``method`` is the default route for cells the
     table has no evidence on. ``shadow_rate`` in [0, 1] samples that
-    fraction of classic dispatches for a shadow solve on the other
+    fraction of classic dispatches for a shadow solve on a losing
     backend (0 = off; the sampling RNG is seeded so runs replay).
     """
 
@@ -265,7 +272,7 @@ class SolverRouter:
         single mutation point for both promotion and rollback —
         callers own eventing/auditing (the router stays a dumb,
         versioned switch). Entries must name known backends; the
-        prewarmed-both-ladders invariant makes any swap 0-recompile."""
+        prewarmed-every-ladder invariant makes any swap 0-recompile."""
         clean: Dict[Tuple[str, float], str] = {}
         for (label, eps), method in table.items():
             if method not in METHODS:
@@ -283,7 +290,7 @@ class SolverRouter:
     def prewarm(self, bucket: Bucket, max_batch: int, dtype,
                 device=None, continuous: bool = False,
                 include_solve: bool = True) -> int:
-        """Compile BOTH backends' ladders for ``bucket`` (each cache's
+        """Compile EVERY backend's ladder for ``bucket`` (each cache's
         own prewarm — sanitizer warmup sealing and cost harvesting
         included), so any later routing decision — table reseed, a
         force(), a chaos flap — dispatches into an existing
@@ -299,8 +306,11 @@ class SolverRouter:
     def maybe_shadow(self, bucket: Bucket, slots: int, dtype, device,
                      qp, x0, y0, method: str, primary: Dict[str, Any],
                      live, harvest, calibrator=None) -> bool:
-        """Sampled re-solve of an already-served batch on the other
-        backend; per-live-lane delta records into ``harvest``. Runs on
+        """Sampled re-solve of an already-served batch on one of the
+        losing backends (uniform over the non-served methods, from the
+        same seeded RNG as the fire decision, so the three-way evidence
+        stream replays); per-live-lane delta records into
+        ``harvest``. Runs on
         the dispatch thread strictly AFTER the primary futures
         resolved — shadow work may add throughput cost (that is the
         price of fresh tables) but never request latency. At most
@@ -315,6 +325,9 @@ class SolverRouter:
         ran."""
         if harvest is None or self.shadow_rate <= 0.0:
             return False
+        losers = [m for m in METHODS if m != method]
+        if not losers:
+            return False
         with self._lock:
             fire = self._shadow_rng.random() < self.shadow_rate
             if fire and self.shadow_budget_per_tick is not None:
@@ -325,9 +338,13 @@ class SolverRouter:
                     self._shadow_in_tick += 1
             elif fire:
                 self._shadow_in_tick += 1
+            # Which loser runs is drawn under the same lock as the fire
+            # decision, so the (fire, alt) stream is one deterministic
+            # replayable sequence.
+            alt = losers[self._shadow_rng.randrange(len(losers))] \
+                if fire else None
         if not fire:
             return False
-        alt = "pdhg" if method == "admm" else "admm"
         try:
             exe = self.caches[alt].get(bucket, slots, dtype, device)
             t0 = time.monotonic()

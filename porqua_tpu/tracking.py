@@ -20,23 +20,55 @@ from typing import NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
-from porqua_tpu.qp.canonical import CanonicalQP, HP
+from porqua_tpu.qp.canonical import CanonicalQP, HP, sketch_rows
 from porqua_tpu.qp.solve import QPSolution, SolverParams, _solve_impl
+
+
+def _sketch_window(X: jax.Array,
+                   y: jax.Array,
+                   sketch_dim: int,
+                   sketch_seed: int):
+    """Embed one (T, N) window + benchmark through the seeded
+    count-sketch: returns ``(Xs, ys, k_probe)`` with ``Xs`` of shape
+    ``(sketch_dim, N)``. The sketch is applied to the stacked
+    ``[X | y]`` so the sketched problem is exactly
+    ``min ||S(Xw - y)||^2`` over the same polytope. The ONE place the
+    embedding is derived — :func:`build_tracking_qp` (the jitted solve
+    path) and ``qp.sketch.sketched_tracking_qp`` (the certificate
+    path) both call it, so the two paths sketch bit-identically; the
+    unused probe key is returned for the latter's ``gram_rel_err``."""
+    k_embed, k_probe = jax.random.split(jax.random.key(sketch_seed))
+    stacked = jnp.concatenate([X, y[:, None]], axis=1)
+    sk = sketch_rows(stacked, sketch_dim, k_embed)
+    return sk[:, :-1], sk[:, -1], k_probe
 
 
 def build_tracking_qp(X: jax.Array,
                       y: jax.Array,
                       ridge: float = 0.0,
                       lb: float = 0.0,
-                      ub: float = 1.0) -> CanonicalQP:
+                      ub: float = 1.0,
+                      sketch_dim: int = 0,
+                      sketch_seed: int = 0) -> CanonicalQP:
     """Lower one (T, N) window to the tracking QP, fully on device.
 
     P = 2 XᵀX (+ 2·ridge·I), q = −2 Xᵀy, budget row Σw = 1, box
     [lb, ub] — the LeastSquares objective (reference
     ``optimization.py:206-226``) under the default budget + LongOnly box
     (reference ``builders.py:258-287``).
+
+    ``sketch_dim > 0`` (and < T) routes the Gram build through the
+    seeded count-sketch (:func:`porqua_tpu.qp.canonical.sketch_rows`):
+    the assembly drops from O(T N²) to O(d N²) and the ``Pf`` factor
+    carries ``sketch_dim`` rows. The branch is trace-time (the dims are
+    static, threaded from ``SolverParams`` by :func:`tracking_step`),
+    so ``sketch_dim=0`` is literally the unsketched program — bit-exact
+    passthrough, pinned by the bench ``sketch_off_identity`` rule. A
+    non-compressing ``sketch_dim >= T`` also passes through.
     """
     dtype = X.dtype
+    if 0 < sketch_dim < X.shape[0]:
+        X, y, _ = _sketch_window(X, y, sketch_dim, sketch_seed)
     n = X.shape[-1]
     # HIGHEST precision (shared policy, see qp/canonical.HP): on TPU the
     # default bf16 passes would perturb the assembled problem ~4e-3
@@ -85,10 +117,18 @@ def tracking_step(Xs: jax.Array,
     windows. Build + solve + evaluate, one XLA program. Jittable with
     ``params``/``ridge`` static; shard the B axis over a mesh for
     multi-chip (see :mod:`porqua_tpu.parallel`).
+
+    ``params.sketch_dim > 0`` feeds the Gram build through the seeded
+    count-sketch *inside* this same program (the north-star path at
+    5,000+ assets) — the solve sees the embedded problem, while the
+    tracking error is ALWAYS evaluated against the true window: the
+    sketch may approximate the problem, never the evaluation.
     """
 
     def one(X, y):
-        qp = build_tracking_qp(X, y, ridge=ridge)
+        qp = build_tracking_qp(X, y, ridge=ridge,
+                               sketch_dim=params.sketch_dim,
+                               sketch_seed=params.sketch_seed)
         sol = _solve_impl(qp, params, None, None)
         resid = jnp.dot(X, sol.x, precision=HP) - y
         te = jnp.sqrt(jnp.mean(resid * resid))

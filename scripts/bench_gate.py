@@ -149,7 +149,15 @@ RULES = [
     # ladders are compiled up front), reconciles its harvest exactly
     # (one backend-tagged record per completed request), and serves
     # zero unsolved requests while flipping backends per bucket.
+    # napg_te_band: the NAPG backend gets the same 2% quality band on
+    # the same headline batch. routing_napg_cell: the seeded three-way
+    # route table must route NAPG on at least one (bucket, eps) cell —
+    # a third backend that never wins a cell is dead routing weight
+    # (the gate grammar has no "ge" op, so the part emits the 0/1
+    # napg_routed_any bit and we pin it to 1).
     ("pdhg_te_band", "config_pdhg.pdhg_te_rel_drift",
+     "le", 0.02, "quality"),
+    ("napg_te_band", "config_napg.napg_te_rel_drift",
      "le", 0.02, "quality"),
     ("sketch_off_identity", "config_sketch.sketch_off_te_drift",
      "le", 1e-6, "invariant"),
@@ -158,6 +166,25 @@ RULES = [
     ("routing_reconciliation", "config_routing.harvest_reconciled",
      "eq", 1, "invariant"),
     ("routing_unsolved", "config_routing.unsolved",
+     "eq", 0, "invariant"),
+    ("routing_napg_cell", "config_routing.napg_routed_any",
+     "eq", 1, "invariant"),
+    # northstar_*: the 5,000-asset sketch-fed run at full paper scale.
+    # The count-sketch Gram embedding must certify (gram_rel_err
+    # bounded — 0.35 is ~1.6x the measured 0.22 at sketch_dim=256,
+    # the certificate regime where the solve still lands inside the
+    # TE band), every backend must SOLVE through the sketch-fed path
+    # (solved_all == 1), the sketched TE may drift from the dense
+    # reference but stays within the calibrated band, and steady-state
+    # serving at n=5000 recompiles nothing.
+    ("northstar_sketch_err", "config_northstar_5k.gram_rel_err",
+     "le", 0.35, "quality"),
+    ("northstar_te_band", "config_northstar_5k.te_rel_drift_max",
+     "le", 1.0, "quality"),
+    ("northstar_solved", "config_northstar_5k.solved_all",
+     "eq", 1, "invariant"),
+    ("northstar_recompiles",
+     "config_northstar_5k.recompiles_after_warmup",
      "eq", 0, "invariant"),
     # calibration_*: the closed-loop config (cold-start empty table,
     # live shadow evidence promotes the winning backend through
@@ -459,9 +486,12 @@ def _selftest() -> int:
     # rules (multi-tenant TENANT_rNN artifacts) and the
     # backend/routing/sketch bars (parts this synthetic payload does
     # not carry — exercised in their own cell below).
-    _part_rules = {"pdhg_te_band", "sketch_off_identity",
+    _part_rules = {"pdhg_te_band", "napg_te_band", "sketch_off_identity",
                    "routing_recompiles", "routing_reconciliation",
-                   "routing_unsolved", "calibration_recompiles",
+                   "routing_unsolved", "routing_napg_cell",
+                   "northstar_sketch_err", "northstar_te_band",
+                   "northstar_solved", "northstar_recompiles",
+                   "calibration_recompiles",
                    "calibration_reconciliation", "calibration_unsolved",
                    "calibration_promoted", "calibration_no_rollback",
                    "calibration_audit_replay", "hlo_findings_total",
@@ -549,10 +579,15 @@ def _selftest() -> int:
     # updated here.
     routed_good = json.loads(json.dumps(base))
     routed_good["config_pdhg"] = {"pdhg_te_rel_drift": 4.3e-4}
+    routed_good["config_napg"] = {"napg_te_rel_drift": 8.1e-4}
     routed_good["config_sketch"] = {"sketch_off_te_drift": 0.0}
     routed_good["config_routing"] = {"recompiles_after_warmup": 0,
                                      "harvest_reconciled": 1,
-                                     "unsolved": 0}
+                                     "unsolved": 0,
+                                     "napg_routed_any": 1}
+    routed_good["config_northstar_5k"] = {
+        "gram_rel_err": 0.22, "te_rel_drift_max": 0.57,
+        "solved_all": 1, "recompiles_after_warmup": 0}
     # Closed-loop calibration cell: a clean cold-start run (one
     # promotion, no rollback, zero recompiles through the table swap,
     # audit chain replaying to the live table) passes every
@@ -566,19 +601,27 @@ def _selftest() -> int:
     assert v_routed["ok"], v_routed["failed"]
     routed_bad = json.loads(json.dumps(routed_good))
     routed_bad["config_pdhg"]["pdhg_te_rel_drift"] = 0.05
+    routed_bad["config_napg"]["napg_te_rel_drift"] = 0.04
     routed_bad["config_sketch"]["sketch_off_te_drift"] = 1e-3
     routed_bad["config_routing"] = {"recompiles_after_warmup": 3,
                                     "harvest_reconciled": 0,
-                                    "unsolved": 2}
+                                    "unsolved": 2,
+                                    "napg_routed_any": 0}
+    routed_bad["config_northstar_5k"] = {
+        "gram_rel_err": 0.6, "te_rel_drift_max": 2.3,
+        "solved_all": 0, "recompiles_after_warmup": 1}
     routed_bad["config_calibration"] = {
         "recompiles_after_warmup": 2, "harvest_reconciled": 0,
         "unsolved": 1, "promotions": 0, "rollbacks": 1,
         "route_table_version": 2, "audit_replay_ok": 0}
     v_routed_bad = check_payload(base, routed_bad)
     assert not v_routed_bad["ok"]
-    for name in ("pdhg_te_band", "sketch_off_identity",
+    for name in ("pdhg_te_band", "napg_te_band", "sketch_off_identity",
                  "routing_recompiles", "routing_reconciliation",
-                 "routing_unsolved", "calibration_recompiles",
+                 "routing_unsolved", "routing_napg_cell",
+                 "northstar_sketch_err", "northstar_te_band",
+                 "northstar_solved", "northstar_recompiles",
+                 "calibration_recompiles",
                  "calibration_reconciliation", "calibration_unsolved",
                  "calibration_promoted", "calibration_no_rollback",
                  "calibration_audit_replay"):

@@ -155,10 +155,14 @@ def _round(service, qps, refs):
 
 
 def _synthetic_evidence(cal, bucket, eps, n=6):
-    """Schema-correct solve/shadow records for one cell, PDHG strictly
-    better on dispatch latency — the deterministic stand-in for the
-    organic shadow stream (bench config_calibration proves the organic
-    path; these drills pin the state machine's transitions)."""
+    """Schema-correct solve/shadow records for one cell, with ALL
+    THREE backends matured as contenders: PDHG strictly better than
+    the ADMM serve stream on dispatch latency, NAPG matured but
+    strictly worse — so the promote drill pins a genuine best-of-three
+    comparison (the winner must beat two losers, not one). The
+    deterministic stand-in for the organic shadow stream (bench
+    config_calibration proves the organic path; these drills pin the
+    state machine's transitions)."""
     for _ in range(n):
         cal.observe({"source": "serve", "bucket": bucket,
                      "eps_abs": eps, "solver": "admm", "status": 1,
@@ -168,6 +172,11 @@ def _synthetic_evidence(cal, bucket, eps, n=6):
                      "status": 1, "iters": 12, "solve_s": 1e-5,
                      "obj": 0.1, "delta_iters": -28,
                      "delta_solve_s": -4e-3, "agree": True})
+        cal.observe({"source": "serve.shadow", "shadow_of": "admm",
+                     "bucket": bucket, "eps_abs": eps, "solver": "napg",
+                     "status": 1, "iters": 80, "solve_s": 8e-3,
+                     "obj": 0.1, "delta_iters": 40,
+                     "delta_solve_s": 4e-3, "agree": True})
 
 
 def _cell_str(bucket, eps):
@@ -202,7 +211,7 @@ def _cell_promote(mode, seed, verbose):
         min_samples=4)
     try:
         svc.start()
-        svc.prewarm(qps[0])  # router path: BOTH backends' ladders
+        svc.prewarm(qps[0])  # router path: EVERY backend's ladder
         warm_fail, warm_wrong = _round(svc, qps, refs)
         svc.metrics.reset_window()
         bucket = sink.buffered()[0]["bucket"]

@@ -128,14 +128,14 @@ MODES = ("classic", "continuous")
 TENANT_CELLS = ("noisy_neighbor", "tenant_feed_corrupt")
 
 #: Solver-routing cell (run_route_flap_cell below; classic AND
-#: continuous): a live SolverRouter force-flipped between the ADMM and
-#: PDHG backends mid-stream under load. Not a fault scenario — no
-#: injector — but the same unforgivable-outcome bar: every result
-#: must match the offline oracle whichever backend served it, both
-#: backends must actually serve traffic, nothing may fail, and the
-#: flapping must compile NOTHING after prewarm (both backends' ladders
-#: are prewarmed up front — a flap that recompiles would be a latency
-#: fault in production).
+#: continuous): a live SolverRouter force-flipped across the ADMM,
+#: PDHG and NAPG backends mid-stream under load. Not a fault scenario
+#: — no injector — but the same unforgivable-outcome bar: every
+#: result must match the offline oracle whichever backend served it,
+#: every backend must actually serve traffic, nothing may fail, and
+#: the flapping must compile NOTHING after prewarm (every backend's
+#: ladder is prewarmed up front — a flap that recompiles would be a
+#: latency fault in production).
 ROUTE_CELLS = ("solver_route_flap",)
 
 #: Closed-loop calibration cells (scripts/calibration_smoke.py
@@ -492,7 +492,7 @@ def run_route_flap_cell(mode, seed, qps, refs, params, ladder,
     wrong, failures = [], []
     try:
         service.start()
-        service.prewarm(qps[0])  # router path: BOTH backends' ladders
+        service.prewarm(qps[0])  # router path: EVERY backend's ladder
         _, w0, f0, _ = _drive_round(service, round_qps)
         wrong += w0
         failures += f0
@@ -500,9 +500,13 @@ def run_route_flap_cell(mode, seed, qps, refs, params, ladder,
 
         submitted = 0
         half = len(round_qps) // 2
-        # (start-of-round pin, mid-round pin); None = unpinned.
-        flaps = [("pdhg", "admm"), ("admm", "pdhg"), ("pdhg", None),
-                 (None, None)]
+        # (start-of-round pin, mid-round pin); None = unpinned. The
+        # schedule walks every backend pair boundary at least once —
+        # including mid-round flips in and out of NAPG (its prox is
+        # exact on this well-conditioned 8x4 family, so the oracle
+        # holds it to the same wrong-answer bar as the others).
+        flaps = [("pdhg", "admm"), ("admm", "napg"), ("napg", "pdhg"),
+                 ("pdhg", None), ("napg", None), (None, None)]
         for start_pin, mid_pin in flaps:
             router.force(start_pin)
             tickets = []
@@ -534,11 +538,11 @@ def run_route_flap_cell(mode, seed, qps, refs, params, ladder,
                 "ok": not wrong,
                 "detail": wrong[:4],
             },
-            "both_backends_served": {
-                "ok": (snap.get("routed_admm", 0) >= 1
-                       and snap.get("routed_pdhg", 0) >= 1),
-                "detail": {"routed_admm": snap.get("routed_admm", 0),
-                           "routed_pdhg": snap.get("routed_pdhg", 0)},
+            "all_backends_served": {
+                "ok": all(snap.get(f"routed_{m}", 0) >= 1
+                          for m in ("admm", "pdhg", "napg")),
+                "detail": {f"routed_{m}": snap.get(f"routed_{m}", 0)
+                           for m in ("admm", "pdhg", "napg")},
             },
             "zero_recompiles": {
                 "ok": snap.get("compiles", 0) == 0,
@@ -559,15 +563,16 @@ def run_route_flap_cell(mode, seed, qps, refs, params, ladder,
             "router": router.snapshot(),
             "counters": {k: snap[k] for k in (
                 "submitted", "completed", "failed", "compiles",
-                "routed_admm", "routed_pdhg")},
+                "routed_admm", "routed_pdhg", "routed_napg")},
         }
         if verbose:
             state = "ok  " if ok else "FAIL"
             bad = [k for k, v in invariants.items() if not v["ok"]]
             print(f"  {state} {'solver_route_flap':<16} {mode:<10} "
-                  f"routed admm/pdhg="
+                  f"routed admm/pdhg/napg="
                   f"{snap.get('routed_admm', 0)}/"
-                  f"{snap.get('routed_pdhg', 0)} failed={len(failures)}"
+                  f"{snap.get('routed_pdhg', 0)}/"
+                  f"{snap.get('routed_napg', 0)} failed={len(failures)}"
                   + (f"  violated: {', '.join(bad)}" if bad else ""),
                   file=sys.stderr)
         return verdict

@@ -91,11 +91,13 @@ def _solver_winner(by_solver: Dict[str, Dict[str, Any]]) -> str:
 
 
 def _render_solver_table(agg: Dict[str, Any]) -> List[str]:
-    """Per-(tenant, bucket, eps) ADMM-vs-PDHG comparison — rendered
-    only when the dataset actually carries the backend axis with more
-    than one backend somewhere (a pure pre-PDHG dataset, where every
-    record reads back as "admm", adds no section). ``win`` marks the
-    backend the routing seed would pick for the cell."""
+    """Per-(tenant, bucket, eps) backend comparison — one row per
+    backend with evidence in the cell (ADMM/PDHG/NAPG, or any future
+    addition: the table grows with the dataset's ``by_solver`` axis).
+    Rendered only when the dataset actually carries the backend axis
+    with more than one backend somewhere (a pure pre-PDHG dataset,
+    where every record reads back as "admm", adds no section). ``win``
+    marks the backend the routing seed would pick for the cell."""
     rows = [g for g in agg["groups"] if g.get("by_solver")]
     if not any(len(g["by_solver"]) > 1 for g in rows):
         return []
@@ -238,34 +240,46 @@ def _selftest() -> int:
     # one-backend table says nothing.
     assert "solver comparison" not in text, text
 
-    # The backend axis: shadow-compare records put both backends in
-    # one cell; the comparison table renders with the seed pick
-    # marked. PDHG solves the cell in a third of the iterations and
-    # half the dispatch latency -> it wins the cell.
+    # The backend axis: shadow-compare records put every backend in
+    # one cell; the comparison table renders one row per backend with
+    # the seed pick marked. PDHG solves the cell in a third of the
+    # iterations and half the dispatch latency -> it wins the
+    # three-way cell; NAPG's matured-but-slower stream renders as a
+    # contender row without flipping the pick.
     p_pdhg = SolverParams(eps_abs=1e-3, eps_rel=1e-3, method="pdhg")
+    p_napg = SolverParams(eps_abs=1e-3, eps_rel=1e-3, method="napg")
     routed = list(records)
     for i in range(16):
         routed.append(solve_record(
             "serve.shadow", 24, 1, 1, 9, 1e-4, 1e-4, -1.0,
             params=p_pdhg, bucket="32x4", solve_s=5e-4,
             shadow_of="admm", delta_iters=-16, agree=True))
+    for i in range(8):
+        routed.append(solve_record(
+            "serve.shadow", 24, 1, 1, 40, 1e-4, 1e-4, -1.0,
+            params=p_napg, bucket="32x4", solve_s=2e-3,
+            shadow_of="admm", delta_iters=15, agree=True))
     agg3 = aggregate(routed)
     cell = next(g for g in agg3["groups"] if g["bucket"] == "32x4")
-    assert set(cell["by_solver"]) == {"admm", "pdhg"}, cell
+    assert set(cell["by_solver"]) == {"admm", "pdhg", "napg"}, cell
     assert _solver_winner(cell["by_solver"]) == "pdhg", cell
     # Routed-decision counts: the 16 serve dispatches all ran on the
-    # router's pick (admm); the pdhg records are shadow re-solves, so
-    # its evidence cell shows count 16 but routed 0.
+    # router's pick (admm); the pdhg/napg records are shadow
+    # re-solves, so their evidence cells show counts but routed 0.
     assert cell["by_solver"]["admm"]["routed"] == 16, cell
     assert cell["by_solver"]["pdhg"]["routed"] == 0, cell
+    assert cell["by_solver"]["napg"]["routed"] == 0, cell
     text3 = render_table(agg3)
-    for needle in ("solver comparison", "pdhg", "serve.shadow x16",
-                   "routed"):
+    for needle in ("solver comparison", "pdhg", "napg",
+                   "serve.shadow x24", "routed"):
         assert needle in text3, f"selftest: {needle!r} missing:\n{text3}"
     assert text3.count("*") >= 1, text3
     pdhg_row = next(ln for ln in text3.splitlines()
                     if " pdhg " in f" {ln} " and "32x4" in ln)
     assert " 16 " in pdhg_row and " 0 " in pdhg_row, pdhg_row
+    napg_row = next(ln for ln in text3.splitlines()
+                    if " napg " in f" {ln} " and "32x4" in ln)
+    assert " 8 " in napg_row and " 0 " in napg_row, napg_row
     # A dataset without audit records renders no calibration section.
     assert render_calibration_table(routed) == [], "unexpected audit"
 

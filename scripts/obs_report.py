@@ -109,13 +109,15 @@ def _selftest() -> int:
     # Calibration timeline: the closed loop's full lifecycle — a
     # candidate promoted through canary, then guard-breached and
     # rolled back — the calibration_section must render with versions
-    # and changed cells.
-    diff = {"32x8@1e-03": {"old": "admm", "new": "pdhg"}}
+    # and changed cells (a three-way promotion: two cells flipping to
+    # two different winners in one table swap).
+    diff = {"32x8@1e-03": {"old": "admm", "new": "pdhg"},
+            "8x1@1e-03": {"old": "admm", "new": "napg"}}
     obs.events.emit("route_reseed", "info", state="candidate",
-                    table_version=0, n_cells=1, diff=diff)
+                    table_version=0, n_cells=2, diff=diff)
     obs.events.emit("route_reseed", "info", state="promoted",
-                    table_version=1, n_cells=1, diff=diff,
-                    table={"32x8@1e-03": "pdhg"})
+                    table_version=1, n_cells=2, diff=diff,
+                    table={"32x8@1e-03": "pdhg", "8x1@1e-03": "napg"})
     obs.events.emit("route_rollback", "error",
                     reason="anomaly_fired +1 since promotion",
                     table_version=2, restored_table={}, diff=diff)
@@ -301,7 +303,8 @@ def _selftest() -> int:
                    # -> rolled back, with versions and changed cells.
                    "calibration timeline",
                    "candidate",
-                   "promoted  v1  32x8@1e-03:admm->pdhg",
+                   "promoted  v1  32x8@1e-03:admm->pdhg, "
+                   "8x1@1e-03:admm->napg",
                    "route_rollback v2  [anomaly_fired +1",
                    "promotions: 1 / rollbacks: 1  !! ROLLED BACK",
                    # The device cost / memory section: per-bucket peak

@@ -337,3 +337,53 @@ def test_tick_errors_never_propagate():
     assert cal.counters()["calibration_tick_errors"] == 1
     with pytest.raises(RuntimeError):
         cal.tick()                             # gate-free entry raises
+
+
+# ---------------------------------------------------------------------------
+# three-backend generalization (NAPG as third contender)
+# ---------------------------------------------------------------------------
+
+def test_three_contender_cell_promotes_best_of_three():
+    """A cell where all three backends matured scores N-ary: NAPG's
+    lower latency beats pdhg AND the admm incumbent, and the promoted
+    table routes the cell to napg."""
+    clk = FaultClock()
+    cal, router, _, events, _ = _mk(clk)
+    for _ in range(6):
+        assert cal.observe(_serve_rec("admm", iters=60, solve_s=4e-3))
+        assert cal.observe(_shadow_rec("pdhg", iters=30, solve_s=2e-3,
+                                       delta_iters=-30,
+                                       delta_solve_s=-2e-3))
+        assert cal.observe(_shadow_rec("napg", iters=12, solve_s=5e-4,
+                                       delta_iters=-48,
+                                       delta_solve_s=-3.5e-3))
+    cal.tick()                                 # idle -> canary
+    assert cal.status()["state"] == "canary"
+    clk.advance(6.0)
+    cal.tick()                                 # dwell held -> promote
+    assert router.snapshot()["table"] == {CELL: "napg"}
+    diff = events.kinds("route_reseed")[1][2]["diff"][CELL]
+    assert diff["old"] == "admm" and diff["new"] == "napg"
+    assert set(diff["evidence"]["per_method"]) == {"admm", "pdhg",
+                                                   "napg"}
+
+
+def test_thin_third_stream_does_not_block_comparison():
+    """A backend below min_samples simply is not a contender yet: two
+    matured backends still compare (and promote) while the third's
+    evidence stream is warming up — the three-way generalization must
+    not regress the two-way promotion latency."""
+    clk = FaultClock()
+    cal, router, _, _, _ = _mk(clk, min_samples=4)
+    for _ in range(6):
+        assert cal.observe(_serve_rec("admm"))
+        assert cal.observe(_shadow_rec("pdhg"))
+    # One napg observation: matured nowhere near min_samples.
+    assert cal.observe(_shadow_rec("napg", iters=500, solve_s=1e-2,
+                                   delta_iters=460,
+                                   delta_solve_s=6e-3))
+    cal.tick()
+    assert cal.status()["state"] == "canary", cal.status()
+    clk.advance(6.0)
+    cal.tick()
+    assert router.snapshot()["table"] == {CELL: "pdhg"}

@@ -1,0 +1,253 @@
+"""Contracts for the NAPG backend (``SolverParams(method="napg")``).
+
+Nesterov-accelerated projected gradient is the third solver backend,
+aimed at the box-only tracking regime (box bounds + a budget row —
+the most common serve bucket). These tests pin what the routing
+subsystem stands on:
+
+* the steppable NAPG API (``napg_init`` / ``napg_segment_step``) is
+  bit-identical to the fused ``napg_solve`` while_loop (same compiled
+  segment program — the compaction/continuous hoist cannot drift);
+* solutions agree with the ADMM backend on the same problems (shared
+  KKT residual measure, shared finalize), so a routing flip changes
+  wall-clock, never answers;
+* the adaptive (gradient) restart actually fires and is observable
+  through the convergence rings (third slot = cumulative restart
+  count, as for PDHG);
+* MAX_ITER retirement + active-set polish fallback work for NAPG
+  lanes exactly as for ADMM/PDHG lanes;
+* the backend-agnostic drivers (vmapped batch solve, compacting
+  driver) accept ``method="napg"`` and agree lane-for-lane.
+
+The test family is box + budget QPs (dense factor P, single budget
+row, box bounds) — NAPG's winning regime, where the per-iteration
+prox reduces to one scalar dual bisection — small enough for CPU CI.
+"""
+
+import dataclasses
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from porqua_tpu.compaction import CompactingDriver
+from porqua_tpu.obs.rings import ring_history
+from porqua_tpu.qp.admm import Status
+from porqua_tpu.qp.canonical import CanonicalQP, stack_qps
+from porqua_tpu.qp.napg import napg_init, napg_segment_step, napg_solve
+from porqua_tpu.qp.ruiz import equilibrate
+from porqua_tpu.qp.solve import SolverParams, solve_qp, solve_qp_batch
+
+# Tight-ish eps with a short check interval: NAPG converges in a few
+# dozen iterations on this family, so check_interval=10 makes every
+# lane take multiple segments (the stepper-parity precondition).
+PARAMS = SolverParams(method="napg", max_iter=2000, eps_abs=1e-6,
+                      eps_rel=1e-6, polish=False, check_interval=10)
+
+N, M, B = 32, 1, 6
+
+
+def _box_qp(rng, n=N, box=0.1):
+    """Dense factor-model P, one budget row, box bounds — the tracking
+    serve bucket at test size (NAPG's target regime)."""
+    F = rng.standard_normal((max(2, n // 4), n))
+    P = F.T @ F / n + 0.05 * np.eye(n)
+    return CanonicalQP.build(
+        P, rng.standard_normal(n) * 0.1,
+        C=np.ones((1, n)), l=np.ones(1), u=np.ones(1),
+        lb=np.zeros(n), ub=np.full(n, box))
+
+
+def _make_batch():
+    rng = np.random.default_rng(7)
+    return stack_qps([_box_qp(rng) for _ in range(B)])
+
+
+@pytest.fixture(scope="module")
+def batch():
+    return _make_batch()
+
+
+# ---------------------------------------------------------------------------
+# steppable API
+# ---------------------------------------------------------------------------
+
+def test_segment_step_matches_napg_solve(batch):
+    """A host loop over jitted napg_segment_step reproduces the fused
+    while_loop bit-for-bit (the twin of the ADMM/PDHG stepper contracts
+    — same hoisted segment program)."""
+    qp = jax.tree.map(lambda a: a[0], batch)
+    scaled, scaling = equilibrate(qp, iters=PARAMS.scaling_iters)
+
+    @functools.partial(jax.jit, static_argnames=("params",))
+    def step(carry, s, sc, params):
+        return napg_segment_step(carry, s, sc, params)[0]
+
+    @functools.partial(jax.jit, static_argnames=("params",))
+    def fused_solve(s, sc, params):
+        return napg_solve(s, sc, params)
+
+    carry = jax.jit(lambda q: napg_init(q, PARAMS))(scaled)
+    n_segments = 0
+    while (int(carry.state.status) == Status.RUNNING
+           and int(carry.state.iters) < PARAMS.max_iter):
+        carry = step(carry, scaled, scaling, PARAMS)
+        n_segments += 1
+    assert n_segments >= 2, "family must take multiple segments"
+    ref = fused_solve(scaled, scaling, PARAMS)
+    got = carry.state._replace(status=jnp.where(
+        carry.state.status == Status.RUNNING, Status.MAX_ITER,
+        carry.state.status).astype(jnp.int32))
+    for name in ("x", "z", "w", "y", "mu", "rho_bar", "iters", "status",
+                 "prim_res", "dual_res"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(got, name)),
+            np.asarray(getattr(ref, name)), err_msg=name)
+
+
+def test_segment_step_never_retires_max_iter(batch):
+    """The stepper leaves budget enforcement to the orchestrator: a
+    lane past ``max_iter`` keeps status RUNNING until a driver (or the
+    fused solve's exit) retires it."""
+    qp = jax.tree.map(lambda a: a[0], batch)
+    short = dataclasses.replace(PARAMS, max_iter=10)
+    scaled, scaling = equilibrate(qp, iters=short.scaling_iters)
+    carry = jax.jit(lambda q: napg_init(q, short))(scaled)
+
+    @functools.partial(jax.jit, static_argnames=("params",))
+    def step(c, s, sc, params):
+        return napg_segment_step(c, s, sc, params)[0]
+
+    for _ in range(3):  # 3 segments = 30 iters >> max_iter=10
+        carry = step(carry, scaled, scaling, short)
+    assert int(carry.state.iters) == 30
+    assert int(carry.state.status) == Status.RUNNING
+
+
+# ---------------------------------------------------------------------------
+# solution agreement with the ADMM backend
+# ---------------------------------------------------------------------------
+
+def test_napg_agrees_with_admm(batch):
+    """Both backends certify SOLVED on every lane and land on the same
+    optimum (shared residual measure -> comparable certificates; the
+    routing flip must never change answers)."""
+    admm_params = dataclasses.replace(PARAMS, method="admm")
+    sol_n = solve_qp_batch(batch, PARAMS)
+    sol_a = solve_qp_batch(batch, admm_params)
+    assert np.all(np.asarray(sol_n.status) == Status.SOLVED), (
+        np.asarray(sol_n.status))
+    assert np.all(np.asarray(sol_a.status) == Status.SOLVED)
+    x_n, x_a = np.asarray(sol_n.x), np.asarray(sol_a.x)
+    np.testing.assert_allclose(x_n, x_a, atol=2e-3)
+    obj_n, obj_a = np.asarray(sol_n.obj_val), np.asarray(sol_a.obj_val)
+    np.testing.assert_allclose(obj_n, obj_a, rtol=1e-3, atol=1e-5)
+    # Certificates are real KKT residuals for this backend too.
+    assert float(np.max(np.asarray(sol_n.prim_res))) < 1e-4
+    assert float(np.max(np.asarray(sol_n.dual_res))) < 1e-4
+
+
+def test_napg_feasible_on_budget_row(batch):
+    """Every NAPG iterate is prox-feasible by construction: the
+    returned x satisfies the budget row and box to tight tolerance
+    (the projection is exact, not penalized)."""
+    sol = solve_qp_batch(batch, PARAMS)
+    x = np.asarray(sol.x)
+    np.testing.assert_allclose(x.sum(axis=1), 1.0, atol=1e-5)
+    assert float(x.min()) >= -1e-7
+    assert float(x.max()) <= 0.1 + 1e-7
+
+
+# ---------------------------------------------------------------------------
+# restarts + rings
+# ---------------------------------------------------------------------------
+
+def test_restarts_fire_and_ring_records_them(batch):
+    """The gradient restart actually triggers on this family, and the
+    rings' third slot carries the cumulative restart count (decoded
+    chronologically it is non-decreasing and ends at the carry's
+    total) — the trajectory diagnostic obs/rings exposes."""
+    qp = jax.tree.map(lambda a: a[0], batch)
+    ringed = dataclasses.replace(PARAMS, ring_size=64)
+    scaled, scaling = equilibrate(qp, iters=ringed.scaling_iters)
+    carry = jax.jit(lambda q: napg_init(q, ringed))(scaled)
+
+    @functools.partial(jax.jit, static_argnames=("params",))
+    def step(c, s, sc, params):
+        return napg_segment_step(c, s, sc, params)[0]
+
+    while (int(carry.state.status) == Status.RUNNING
+           and int(carry.state.iters) < ringed.max_iter):
+        carry = step(carry, scaled, scaling, ringed)
+
+    n_restarts = int(carry.restart_count)
+    assert n_restarts >= 1, "restart machinery never fired"
+    hist = ring_history(carry.state.ring_prim, carry.state.ring_dual,
+                        carry.state.ring_rho, int(carry.state.iters),
+                        ringed.check_interval)
+    counts = hist["rho"]  # NAPG: cumulative restart count per segment
+    assert counts == sorted(counts), counts
+    assert int(counts[-1]) == n_restarts, (counts, n_restarts)
+    # The trajectory converged: final ring sample equals the state's
+    # residuals exactly (polish=False contract from qp/solve.py).
+    assert hist["prim_res"][-1] == float(carry.state.prim_res)
+    assert hist["dual_res"][-1] == float(carry.state.dual_res)
+
+
+# ---------------------------------------------------------------------------
+# MAX_ITER retirement + polish fallback
+# ---------------------------------------------------------------------------
+
+def test_max_iter_polish_fallback(batch):
+    """A NAPG lane retired out of budget still gets the active-set
+    polish and is re-graded SOLVED when the polished point meets
+    tolerance — the same finalize contract as ADMM/PDHG lanes."""
+    qp = jax.tree.map(lambda a: a[0], batch)
+    starved = dataclasses.replace(PARAMS, max_iter=20)
+    raw = solve_qp(qp, starved)
+    assert int(raw.status) == Status.MAX_ITER
+    polished = solve_qp(qp, dataclasses.replace(starved, polish=True))
+    assert int(polished.iters) == 20  # polish adds accuracy, not iters
+    assert float(polished.prim_res) <= float(raw.prim_res)
+    assert float(polished.dual_res) <= float(raw.dual_res)
+    # On this well-conditioned family one polish pass reaches
+    # tolerance from 20 NAPG iterations -> the re-grade fires.
+    assert int(polished.status) == Status.SOLVED
+
+
+# ---------------------------------------------------------------------------
+# backend-agnostic drivers
+# ---------------------------------------------------------------------------
+
+def test_compaction_parity_with_napg(batch):
+    """The compacting driver is backend-agnostic: with method="napg"
+    lanes agree with the vmapped fused solve in the original lane
+    order with zero post-prewarm compiles. Statuses and iteration
+    counts — what serve dispatch and harvest reconciliation stand
+    on — are bit-equal. The continuous quantities are pinned to ulp
+    tolerance rather than bitwise: NAPG lanes retire at widely spread
+    iteration counts, so (unlike the PDHG/ADMM parity families) this
+    family exercises the batch-1 rung of the repack ladder, where
+    XLA:CPU lowers the factor matvec with a different accumulation
+    order — the identical per-lane program rounds the last ulp
+    differently. (PDHG has the same property; its test family just
+    never repacks down to one lane.)"""
+    fused = solve_qp_batch(batch, PARAMS)
+    driver = CompactingDriver(PARAMS)
+    compiled = driver.prewarm(B, N, M)
+    assert compiled > 0
+    sol, rep = driver.solve(batch)
+    assert rep.compiles == 0, "prewarmed solve must not compile"
+    status = np.asarray(fused.status)
+    assert np.all(status == Status.SOLVED)
+    np.testing.assert_array_equal(np.asarray(sol.status), status)
+    np.testing.assert_array_equal(np.asarray(sol.iters),
+                                  np.asarray(fused.iters))
+    for name in ("x", "z", "y", "mu", "prim_res", "dual_res"):
+        np.testing.assert_allclose(
+            np.asarray(getattr(sol, name)),
+            np.asarray(getattr(fused, name)), atol=1e-7, rtol=1e-6,
+            err_msg=name)

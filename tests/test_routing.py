@@ -101,7 +101,7 @@ def test_seed_from_aggregate():
     # One-sided and unseen cells fall back to the service default.
     assert router.route(Bucket(16, 8, None)) == "admm"
     assert router.route(Bucket(64, 4, None)) == "admm"
-    assert router.decisions() == {"admm": 3, "pdhg": 2}
+    assert router.decisions() == {"admm": 3, "pdhg": 2, "napg": 0}
 
     # decide() resolves to the matching backend's executable cache.
     method, cache = router.decide(Bucket(8, 4, None))
@@ -205,7 +205,12 @@ def test_shadow_budget_caps_and_defers():
     router = SolverRouter(PARAMS, shadow_rate=1.0, shadow_seed=0,
                           shadow_budget_per_tick=2)
     fake = _FakeShadowCache(PDHG)
-    router.caches["pdhg"] = fake
+    # One fake serves every losing backend: the alt choice is sampled
+    # among ALL losers now, and this test pins budget accounting, not
+    # which loser won the draw.
+    for alt in METHODS:
+        if alt != "admm":
+            router.caches[alt] = fake
     harvest = HarvestSink()
     cal = Calibrator()
     lane = types.SimpleNamespace(n_orig=6, m_orig=2, tenant=None)
@@ -299,10 +304,81 @@ def test_routed_service_shadow_and_flip():
         assert isinstance(r["delta_iters"], int)
         assert isinstance(r["agree"], bool)
         assert r["bucket"] == "8x4"
-    # Both directions observed (admm-primary before the flip,
-    # pdhg-primary after).
-    assert {r["shadow_of"] for r in shadows} == set(METHODS)
+    # Both served primaries observed (admm before the flip, pdhg
+    # after); the shadowed loser is sampled among the OTHER two
+    # backends, so napg appears as a solver, never as a primary here.
+    assert {r["shadow_of"] for r in shadows} == {"admm", "pdhg"}
     # The aggregate's backend axis picks both solvers up.
     cell = next(g for g in aggregate(harvest.buffered())["groups"]
                 if g.get("by_solver") and len(g["by_solver"]) > 1)
     assert set(cell["by_solver"]) <= set(METHODS)
+
+
+# ---------------------------------------------------------------------------
+# three-backend generalization (NAPG as third contender)
+# ---------------------------------------------------------------------------
+
+def test_seed_three_way_napg_wins_box_cell():
+    """With three contenders in one cell the scoring is N-ary: NAPG's
+    faster dispatch wins the box-only bucket over both incumbents, and
+    a cell where only two of the three backends reported still
+    compares (two-sided evidence is enough; three-sided is better)."""
+    recs = []
+    # Cell 8x1 (box+budget): all three solved, napg fastest.
+    recs += _records("8x1", "admm", 10, iters=60, solve_s=4e-3)
+    recs += _records("8x1", "pdhg", 10, iters=400, solve_s=6e-3)
+    recs += _records("8x1", "napg", 10, iters=30, solve_s=8e-4)
+    # Cell 16x4 (general rows): napg honestly retires MAX_ITER —
+    # solved share rules it out even though its latency is lowest.
+    recs += _records("16x4", "admm", 10, iters=80, solve_s=3e-3)
+    recs += _records("16x4", "pdhg", 10, iters=200, solve_s=2e-3)
+    recs += _records("16x4", "napg", 10, iters=500, solve_s=1e-3,
+                     status=int(Status.MAX_ITER))
+    # Cell 32x1: only admm + napg observed.
+    recs += _records("32x1", "admm", 10, iters=70, solve_s=5e-3)
+    recs += _records("32x1", "napg", 10, iters=25, solve_s=9e-4)
+
+    router = SolverRouter(PARAMS)
+    written = router.seed_from_aggregate(aggregate(recs))
+    assert written == {f"8x1@{EPS:.0e}": "napg",
+                       f"16x4@{EPS:.0e}": "pdhg",
+                       f"32x1@{EPS:.0e}": "napg"}, written
+    assert router.route(Bucket(8, 1, None)) == "napg"
+    assert router.route(Bucket(16, 4, None)) == "pdhg"
+    assert router.route(Bucket(32, 1, None)) == "napg"
+
+
+def test_shadow_sampling_covers_all_losers():
+    """shadow_rate=1.0 with a three-backend METHODS: every dispatch
+    shadows, and the seeded loser draw exercises BOTH losing backends
+    over a stream (no loser starves for evidence)."""
+    import types
+    router = SolverRouter(PARAMS, shadow_rate=1.0, shadow_seed=3)
+    fakes = {}
+    for alt in METHODS:
+        if alt != "admm":
+            fakes[alt] = _FakeShadowCache(
+                dataclasses.replace(PARAMS, method=alt))
+            router.caches[alt] = fakes[alt]
+    harvest = HarvestSink()
+    lane = types.SimpleNamespace(n_orig=6, m_orig=2, tenant=None)
+    primary = {"status": np.array([1]), "iters": np.array([40]),
+               "obj": np.array([0.4]), "solve_s": 4e-3}
+    for _ in range(24):
+        assert router.maybe_shadow(Bucket(8, 4, None), 1, None, None,
+                                   None, None, None, "admm", primary,
+                                   [lane], harvest)
+    assert all(f.calls > 0 for f in fakes.values()), {
+        m: f.calls for m, f in fakes.items()}
+    solvers = {r["solver"] for r in harvest.buffered()
+               if r["source"] == "serve.shadow"}
+    assert solvers == {m for m in METHODS if m != "admm"}
+
+
+def test_set_table_accepts_napg_routes():
+    router = SolverRouter(PARAMS)
+    v = router.set_table({("8x1", EPS): "napg", ("16x4", EPS): "pdhg"})
+    assert v == 1
+    assert router.route(Bucket(8, 1, None)) == "napg"
+    with pytest.raises(ValueError, match="unknown method"):
+        router.set_table({("8x1", EPS): "qpth"})
